@@ -1,0 +1,35 @@
+"""seaweedfs_tpu — a TPU-native distributed object/file store.
+
+A ground-up re-design of the capabilities of SeaweedFS (reference:
+/root/reference, ~50k LoC Go) for TPU hardware:
+
+- An O(1)-seek blob store: "needles" packed into append-only "volumes"
+  (reference: weed/storage/).
+- RS(10,4) Reed-Solomon erasure coding of sealed volumes, with the GF(2^8)
+  encode/reconstruct math expressed as a JAX/Pallas bitplane matmul running
+  on the TPU MXU/VPU instead of amd64 PSHUFB assembly
+  (reference: weed/storage/erasure_coding/ + klauspost/reedsolomon).
+- A metadata master with heartbeat-driven topology, rack-aware placement and
+  client pubsub (reference: weed/server/master_server.go, weed/topology/).
+- A POSIX-ish metadata tier ("filer"), S3 gateway, and WebDAV
+  (reference: weed/filer2/, weed/s3api/, weed/server/webdav_server.go).
+
+Layout:
+- ec/        GF(256) field math, RS matrices, encoders, stripe locate math
+- ops/       Pallas TPU kernels (GF(256) bitplane matmul)
+- models/    flagship jittable pipelines (encode / rebuild / degraded read)
+- parallel/  device-mesh sharding of batched EC work (shard_map, collectives)
+- storage/   needle format, needle maps, volumes, superblock, vacuum
+- topology/  cluster model: DataCenter/Rack/DataNode, placement, layouts
+- master/    master server: heartbeats, assign, sequencer, pubsub
+- server/    volume server / filer server HTTP+RPC frontends
+- filer/     filer core: entries, chunk overlay algebra, store plugins
+- s3/        S3 REST gateway
+- shell/     admin commands (ec.encode / ec.rebuild / ec.balance / ...)
+- security/  JWT write tokens, guards
+- stats/     metrics
+- util/      config, http helpers, crc
+- native/    C++ accelerated host components (crc32c, needle map)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,7 @@
+"""Erasure coding: GF(256) math, RS(10,4) encoders, stripe layout."""
+
+from .gf import (  # noqa: F401
+    DATA_SHARDS,
+    PARITY_SHARDS,
+    TOTAL_SHARDS,
+)

@@ -1,0 +1,28 @@
+"""Test harness configuration.
+
+Forces an 8-device virtual CPU platform so multi-chip sharding
+(jax.sharding.Mesh + shard_map) is exercised without TPU hardware, mirroring
+how the driver dry-runs `__graft_entry__.dryrun_multichip`.
+
+Must run before jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]

@@ -1,0 +1,130 @@
+"""GF(256) field + RS matrix unit tests (phase-0 oracles)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import gf
+from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
+
+
+def test_field_basics():
+    # alpha=2 generates the multiplicative group; known values for poly 0x11D.
+    assert gf.gf_mul(0, 5) == 0
+    assert gf.gf_mul(1, 77) == 77
+    assert gf.gf_mul(2, 0x80) == 0x1D  # overflow reduces by the polynomial
+    for a in (1, 2, 3, 97, 255):
+        assert gf.gf_mul(a, gf.gf_inv(a)) == 1
+        assert gf.gf_div(gf.gf_mul(a, 7), 7) == a
+
+
+def test_field_is_a_field():
+    # spot-check associativity/distributivity on a sample grid
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf.gf_mul(a, gf.gf_mul(b, c)) == gf.gf_mul(gf.gf_mul(a, b), c)
+        assert gf.gf_mul(a, b ^ c) == gf.gf_mul(a, b) ^ gf.gf_mul(a, c)
+
+
+def test_mul_table_matches_scalar():
+    for c in (0, 1, 2, 29, 142, 255):
+        t = gf.mul_table(c)
+        for x in (0, 1, 7, 128, 255):
+            assert t[x] == gf.gf_mul(c, x)
+
+
+def test_matrix_inversion_roundtrip():
+    rng = np.random.default_rng(1)
+    for n in (1, 3, 10):
+        while True:
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = gf.mat_invert(m)
+                break
+            except ValueError:
+                continue
+        assert np.array_equal(gf.mat_mul(m, inv), gf.mat_identity(n))
+
+
+def test_rs_matrix_systematic_and_mds():
+    m = gf.rs_matrix()
+    assert m.shape == (14, 10)
+    assert np.array_equal(m[:10], gf.mat_identity(10))
+    # MDS property: every 10-of-14 row subset must be invertible.
+    for rows in itertools.combinations(range(14), 10):
+        gf.mat_invert(m[list(rows)])  # raises if singular
+
+
+def test_cpu_encoder_roundtrip_all_subsets():
+    rng = np.random.default_rng(2)
+    enc = CpuEncoder()
+    data = [rng.integers(0, 256, 512).astype(np.uint8) for _ in range(10)]
+    shards = enc.encode(list(data))
+    assert len(shards) == 14
+    assert enc.verify(shards)
+
+    # Reconstruct the full shard set from every 10-of-14 subset.
+    for keep in itertools.combinations(range(14), 10):
+        partial = [shards[i] if i in keep else None for i in range(14)]
+        rebuilt = enc.reconstruct(partial)
+        for a, b in zip(rebuilt, shards):
+            assert np.array_equal(a, b)
+
+
+def test_cpu_encoder_reconstruct_data_only():
+    rng = np.random.default_rng(3)
+    enc = CpuEncoder()
+    shards = enc.encode([rng.integers(0, 256, 64).astype(np.uint8)
+                         for _ in range(10)])
+    partial = list(shards)
+    partial[3] = None
+    partial[12] = None
+    out = enc.reconstruct_data(partial)
+    assert np.array_equal(out[3], shards[3])
+    assert out[12] is None  # parity not rebuilt on the data-only path
+
+
+def test_reconstruct_needs_k_shards():
+    enc = CpuEncoder()
+    shards = enc.encode([np.zeros(8, np.uint8) for _ in range(10)])
+    partial = [None] * 5 + list(shards[5:14])
+    assert len([s for s in partial if s is not None]) == 9
+    with pytest.raises(ValueError):
+        enc.reconstruct(partial)
+
+
+def test_bitplane_constants_reproduce_mul():
+    coeff = gf.parity_matrix()
+    bp = gf.bitplane_constants(coeff)
+    rng = np.random.default_rng(4)
+    for _ in range(50):
+        p = int(rng.integers(0, 4))
+        i = int(rng.integers(0, 10))
+        x = int(rng.integers(0, 256))
+        want = gf.gf_mul(int(coeff[p, i]), x)
+        got = 0
+        for j in range(8):
+            if (x >> j) & 1:
+                got ^= int(bp[p, i, j])
+        assert got == want
+
+
+def test_gf2_matrix_reproduces_parity():
+    coeff = gf.parity_matrix()
+    b = gf.gf2_matrix(coeff)  # (32, 80)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, 10).astype(np.uint8)
+    # expand to 80 input bits (byte i, bit j -> index i*8+j)
+    in_bits = np.array([(int(data[i]) >> j) & 1
+                        for i in range(10) for j in range(8)], dtype=np.int64)
+    out_bits = (b.astype(np.int64) @ in_bits) % 2
+    parity_bytes = [
+        sum(int(out_bits[p * 8 + bit]) << bit for bit in range(8))
+        for p in range(4)
+    ]
+    enc = CpuEncoder()
+    shards = enc.encode([np.array([v], np.uint8) for v in data])
+    for p in range(4):
+        assert parity_bytes[p] == int(shards[10 + p][0])
