@@ -1,0 +1,179 @@
+"""EC volume: serve needle reads from shard files, with degraded-read
+reconstruction when shards are missing.
+
+Reference: ec_volume.go:24-72 (open .ecx/.ecj + shards),
+ec_volume.go:183-228 (LocateEcShardNeedle + sorted-index search),
+ec_shard.go:87-91 (shard ReadAt), store_ec.go:119-209 (interval gather,
+local -> remote -> recover fallback), ec_volume_delete.go (tombstone in
+.ecx + .ecj journal).
+
+This class covers the local/in-process part; the volume server layer adds
+the remote-shard gRPC-analog fetch. `fetch_remote` is the injection point:
+fn(shard_id, offset, size) -> bytes | None.
+"""
+
+from __future__ import annotations
+
+import os
+
+import threading
+from typing import Callable
+
+from ..storage import types as t
+from ..storage.needle import Needle
+from ..storage.needle_map import SortedFileNeedleMap
+from . import gf
+from .locate import (LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, Interval,
+                     locate_data)
+from .pipeline import get_encoder, to_ext, _transform_buffers
+
+import numpy as np
+
+
+class EcVolumeError(Exception):
+    pass
+
+
+class NotFoundError(EcVolumeError):
+    pass
+
+
+class EcVolume:
+    def __init__(self, dirname: str, collection: str, vid: int,
+                 version: int = t.CURRENT_VERSION,
+                 large_block: int = LARGE_BLOCK_SIZE,
+                 small_block: int = SMALL_BLOCK_SIZE,
+                 encoder=None,
+                 fetch_remote: Callable[[int, int, int], bytes | None] | None = None):
+        self.dir = dirname
+        self.collection = collection
+        self.vid = vid
+        self.version = version
+        self.large_block = large_block
+        self.small_block = small_block
+        self._encoder = encoder
+        self.fetch_remote = fetch_remote
+        base = collection + "_" + str(vid) if collection else str(vid)
+        self.base_name = os.path.join(dirname, base)
+        self._ecx = SortedFileNeedleMap(self.base_name + ".ecx",
+                                        writable=True)
+        self._ecj = open(self.base_name + ".ecj", "ab")
+        self._lock = threading.RLock()
+        self.shards: dict[int, object] = {}
+        for sid in range(gf.TOTAL_SHARDS):
+            p = self.base_name + to_ext(sid)
+            if os.path.exists(p):
+                self.shards[sid] = open(p, "rb")
+
+    # ---- index ----
+
+    def find_needle(self, needle_id: int) -> tuple[int, int]:
+        """Binary search .ecx -> (offset, size incl. tombstones); raises
+        NotFoundError (SearchNeedleFromSortedIndex, ec_volume.go:203-228)."""
+        raw = self._ecx.get_raw(needle_id)
+        if raw is None:
+            raise NotFoundError(f"needle {needle_id:x} not in ecx")
+        return raw
+
+    def delete_needle(self, needle_id: int) -> None:
+        """Mark deleted in .ecx + journal to .ecj
+        (DeleteNeedleFromEcx, ec_volume_delete.go:27-49)."""
+        with self._lock:
+            if self._ecx.mark_deleted(needle_id):
+                self._ecj.write(needle_id.to_bytes(8, "big"))
+                self._ecj.flush()
+
+    # ---- data path ----
+
+    @property
+    def shard_size(self) -> int:
+        for f in self.shards.values():
+            f.seek(0, os.SEEK_END)
+            return f.tell()
+        return 0
+
+    @property
+    def dat_size(self) -> int:
+        return gf.DATA_SHARDS * self.shard_size
+
+    def encoder(self):
+        if self._encoder is None:
+            self._encoder = get_encoder()
+        return self._encoder
+
+    def _read_shard_interval(self, sid: int, offset: int, size: int) -> bytes:
+        """local shard -> remote fetch -> on-the-fly reconstruct
+        (readOneEcShardInterval, store_ec.go:178-209)."""
+        f = self.shards.get(sid)
+        if f is not None:
+            f.seek(offset)
+            data = f.read(size)
+            if len(data) == size:
+                return data
+            return data + b"\x00" * (size - len(data))
+        if self.fetch_remote is not None:
+            data = self.fetch_remote(sid, offset, size)
+            if data is not None:
+                return data
+        return self._recover_interval(sid, offset, size)
+
+    def _recover_interval(self, want_sid: int, offset: int, size: int) -> bytes:
+        """Gather the same interval from >=10 other shards and decode
+        (recoverOneRemoteEcShardInterval, store_ec.go:319-373)."""
+        bufs: list[np.ndarray] = []
+        rows: list[int] = []
+        for sid in range(gf.TOTAL_SHARDS):
+            if sid == want_sid or len(rows) == gf.DATA_SHARDS:
+                continue
+            data: bytes | None = None
+            f = self.shards.get(sid)
+            if f is not None:
+                f.seek(offset)
+                raw = f.read(size)
+                data = raw + b"\x00" * (size - len(raw))
+            elif self.fetch_remote is not None:
+                data = self.fetch_remote(sid, offset, size)
+            if data is not None:
+                rows.append(sid)
+                bufs.append(np.frombuffer(data, np.uint8))
+        if len(rows) < gf.DATA_SHARDS:
+            raise EcVolumeError(
+                f"cannot recover shard {want_sid}: only {len(rows)} "
+                f"sources available")
+        coeff = gf.shard_rows([want_sid], rows)
+        out = _transform_buffers(self.encoder(), coeff, bufs)
+        return np.asarray(out[0], np.uint8).tobytes()
+
+    def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
+        """Locate via .ecx, gather stripe intervals, parse + CRC-check
+        (ReadEcShardNeedle, store_ec.go:119-153)."""
+        with self._lock:
+            offset, size = self.find_needle(needle_id)
+            if size == t.TOMBSTONE_FILE_SIZE:
+                raise NotFoundError(f"needle {needle_id:x} deleted")
+            record_len = t.actual_size(size, self.version)
+            intervals = locate_data(self.large_block, self.small_block,
+                                    self.dat_size, offset, record_len)
+            parts = []
+            for iv in intervals:
+                sid, soff = iv.to_shard_and_offset(self.large_block,
+                                                   self.small_block)
+                parts.append(self._read_shard_interval(sid, soff, iv.size))
+            blob = b"".join(parts)
+        n = Needle.from_bytes(blob, self.version)
+        if cookie is not None and n.cookie != cookie:
+            raise NotFoundError(f"cookie mismatch for {needle_id:x}")
+        return n
+
+    def close(self) -> None:
+        self._ecx.close()
+        self._ecj.close()
+        for f in self.shards.values():
+            f.close()
+
+    def destroy(self) -> None:
+        self.close()
+        for ext in [".ecx", ".ecj"] + [to_ext(i) for i in range(gf.TOTAL_SHARDS)]:
+            p = self.base_name + ext
+            if os.path.exists(p):
+                os.remove(p)

@@ -1,0 +1,96 @@
+"""EC stripe layout math: map (offset, size) in the logical volume to
+shard-local intervals.
+
+Layout (reference: ec_encoder.go:16-22, ec_locate.go:11-83): the logical
+.dat byte stream is laid out row-major into DATA_SHARDS=10 columns — first
+as rows of 10 x large blocks (1GB), then rows of 10 x small blocks (1MB)
+for the tail. Shard file i holds column i: its large blocks in row order,
+then its small blocks. Any (offset, size) maps to a list of
+(shard_id, shard_offset, length) intervals by pure arithmetic — this is
+the "sequence parallel" layout of the storage world, and the shape the TPU
+mesh shards batches of volumes over.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .gf import DATA_SHARDS
+
+LARGE_BLOCK_SIZE = 1024 * 1024 * 1024  # 1GB
+SMALL_BLOCK_SIZE = 1024 * 1024         # 1MB
+
+
+@dataclass(frozen=True)
+class Interval:
+    block_index: int          # column-major index within its block area
+    inner_offset: int
+    size: int
+    is_large_block: bool
+    large_block_rows: int
+
+    def to_shard_and_offset(self, large_block: int = LARGE_BLOCK_SIZE,
+                            small_block: int = SMALL_BLOCK_SIZE
+                            ) -> tuple[int, int]:
+        """(shard_id, offset within shard file) — ec_locate.go:73-83."""
+        off = self.inner_offset
+        row = self.block_index // DATA_SHARDS
+        if self.is_large_block:
+            off += row * large_block
+        else:
+            off += self.large_block_rows * large_block + row * small_block
+        return self.block_index % DATA_SHARDS, off
+
+
+def locate_offset(large_block: int, small_block: int, dat_size: int,
+                  offset: int) -> tuple[int, bool, int]:
+    """-> (block_index, is_large_block, inner_offset) — ec_locate.go:50-66."""
+    large_row = large_block * DATA_SHARDS
+    n_large_rows = dat_size // large_row
+    if offset < n_large_rows * large_row:
+        return offset // large_block, True, offset % large_block
+    offset -= n_large_rows * large_row
+    return offset // small_block, False, offset % small_block
+
+
+def locate_data(large_block: int, small_block: int, dat_size: int,
+                offset: int, size: int) -> list[Interval]:
+    """Split (offset, size) into per-block intervals — ec_locate.go:11-48."""
+    block_index, is_large, inner = locate_offset(
+        large_block, small_block, dat_size, offset)
+    # +10*small ensures the large-row count is derivable from a shard size
+    n_large_rows = (dat_size + DATA_SHARDS * small_block) // (
+        large_block * DATA_SHARDS)
+    out: list[Interval] = []
+    while size > 0:
+        block_len = large_block if is_large else small_block
+        remaining = block_len - inner
+        take = min(size, remaining)
+        out.append(Interval(block_index, inner, take, is_large, n_large_rows))
+        size -= take
+        if size == 0:
+            return out
+        block_index += 1
+        if is_large and block_index == n_large_rows * DATA_SHARDS:
+            is_large = False
+            block_index = 0
+        inner = 0
+    return out
+
+
+def shard_file_size(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,
+                    small_block: int = SMALL_BLOCK_SIZE) -> int:
+    """Size of each shard file for a given logical volume size.
+
+    Mirrors the encode loop (ec_encoder.go:204-225): full large rows while
+    remaining > one large row, then small rows (zero-padded) for the tail.
+    """
+    large_row = large_block * DATA_SHARDS
+    small_row = small_block * DATA_SHARDS
+    n_large_rows = 0
+    remaining = dat_size
+    while remaining > large_row:
+        n_large_rows += 1
+        remaining -= large_row
+    n_small_rows = -(-remaining // small_row) if remaining > 0 else 0
+    return n_large_rows * large_block + n_small_rows * small_block

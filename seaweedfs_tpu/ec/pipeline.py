@@ -1,0 +1,217 @@
+"""EC file pipelines: volume <-> 14 shard files, driven by the TPU encoder.
+
+Reference workflow (ec_encoder.go):
+  WriteEcFiles (:53)        .dat -> .ec00...ec13, streaming row batches
+  WriteSortedFileFromIdx(:26) .idx -> .ecx sorted index
+  RebuildEcFiles (:57)      regenerate missing shard files from >=10 present
+  ec_decoder.go WriteDatFile(:150) shards -> .dat (ec.decode)
+
+The reference streams 256KB x 10 buffers through an AVX2 encoder; here each
+row batch is a host->HBM transfer and one Pallas kernel launch, so the
+batch unit is much larger (default 8MB per shard) to amortise dispatch and
+keep the kernel DMA-bound.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..storage import types as t
+from ..storage.needle_map import walk_index_blob, write_sorted_index
+from . import gf
+from .locate import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE
+
+
+def to_ext(shard_id: int) -> str:
+    return ".ec%02d" % shard_id
+
+
+def get_encoder(backend: str = "auto"):
+    """backend: 'tpu' | 'cpu' | 'auto' (tpu if a TPU is attached)."""
+    if backend == "auto":
+        try:
+            import jax
+            backend = "tpu" if jax.default_backend() == "tpu" else "cpu"
+        except Exception:
+            backend = "cpu"
+    if backend == "tpu":
+        from .encoder_jax import JaxEncoder
+        return JaxEncoder()
+    from .encoder_cpu import CpuEncoder
+    return CpuEncoder()
+
+
+def _transform_buffers(encoder, coeff: np.ndarray,
+                       buffers: list[np.ndarray]) -> list[np.ndarray]:
+    """Apply a GF coefficient matrix to equal-length host byte buffers."""
+    from .encoder_jax import JaxEncoder
+    if isinstance(encoder, JaxEncoder):
+        import jax
+        from ..ops.gf256_pallas import (bytes_to_words, gf256_words_transform,
+                                        words_to_bytes)
+        n = len(buffers[0])
+        words = [jax.device_put(bytes_to_words(b)) for b in buffers]
+        consts = gf.bitplane_constants(coeff)
+        outs = gf256_words_transform(consts, words)
+        return [words_to_bytes(np.asarray(o), n).copy() for o in outs]
+    # CPU path: plain table-lookup matmul
+    from .encoder_cpu import CpuEncoder
+    return CpuEncoder._apply(np.asarray(coeff, np.uint8),
+                             [np.asarray(b, np.uint8) for b in buffers])
+
+
+def write_ec_files(base_name: str, encoder=None,
+                   large_block: int = LARGE_BLOCK_SIZE,
+                   small_block: int = SMALL_BLOCK_SIZE,
+                   buffer_size: int = 8 * 1024 * 1024) -> None:
+    """Stripe <base>.dat into <base>.ec00 .. .ec13 (WriteEcFiles)."""
+    encoder = encoder or get_encoder()
+    parity = gf.parity_matrix()
+    dat_path = base_name + ".dat"
+    dat_size = os.path.getsize(dat_path)
+    outs = [open(base_name + to_ext(i), "wb") for i in range(gf.TOTAL_SHARDS)]
+    try:
+        with open(dat_path, "rb") as f:
+            remaining = dat_size
+            processed = 0
+            large_row = large_block * gf.DATA_SHARDS
+            while remaining > large_row:
+                _encode_one_block_row(f, processed, large_block,
+                                      min(buffer_size, large_block),
+                                      parity, encoder, outs)
+                processed += large_row
+                remaining -= large_row
+            while remaining > 0:
+                _encode_one_block_row(f, processed, small_block,
+                                      min(buffer_size, small_block),
+                                      parity, encoder, outs)
+                processed += small_block * gf.DATA_SHARDS
+                remaining -= small_block * gf.DATA_SHARDS
+    finally:
+        for o in outs:
+            o.close()
+
+
+def _encode_one_block_row(f, start: int, block_size: int, buf_size: int,
+                          parity: np.ndarray, encoder, outs) -> None:
+    """Encode one row of 10 x block_size bytes in buf_size batches
+    (encodeData/encodeDataOneBatch, ec_encoder.go:114-186)."""
+    assert block_size % buf_size == 0, (block_size, buf_size)
+    for b in range(block_size // buf_size):
+        buffers = []
+        for i in range(gf.DATA_SHARDS):
+            f.seek(start + block_size * i + b * buf_size)
+            raw = f.read(buf_size)
+            if len(raw) < buf_size:
+                raw = raw + b"\x00" * (buf_size - len(raw))
+            buffers.append(np.frombuffer(raw, np.uint8))
+        parities = _transform_buffers(encoder, parity, buffers)
+        for i in range(gf.DATA_SHARDS):
+            outs[i].write(buffers[i].tobytes())
+        for p, buf in enumerate(parities):
+            outs[gf.DATA_SHARDS + p].write(np.asarray(buf, np.uint8).tobytes())
+
+
+def write_sorted_file_from_idx(base_name: str,
+                               ext: str = ".ecx") -> None:
+    """<base>.idx -> sorted <base>.ecx (WriteSortedFileFromIdx,
+    ec_encoder.go:26-50). Tombstoned entries keep TOMBSTONE size."""
+    with open(base_name + ".idx", "rb") as f:
+        blob = f.read()
+    entries = list(walk_index_blob(blob))
+    write_sorted_index(entries, base_name + ext)
+
+
+def present_shards(base_name: str) -> list[int]:
+    return [i for i in range(gf.TOTAL_SHARDS)
+            if os.path.exists(base_name + to_ext(i))]
+
+
+def rebuild_ec_files(base_name: str, encoder=None,
+                     buffer_size: int = 8 * 1024 * 1024) -> list[int]:
+    """Regenerate missing shard files from >=10 present ones
+    (RebuildEcFiles -> rebuildEcFiles, ec_encoder.go:227-281).
+    Returns the rebuilt shard ids."""
+    encoder = encoder or get_encoder()
+    have = present_shards(base_name)
+    missing = [i for i in range(gf.TOTAL_SHARDS) if i not in have]
+    if not missing:
+        return []
+    if len(have) < gf.DATA_SHARDS:
+        raise ValueError(
+            f"unrepairable: only {len(have)} shards present, "
+            f"need {gf.DATA_SHARDS}")
+    use = have[:gf.DATA_SHARDS]
+    coeff = gf.shard_rows(missing, use)
+    shard_size = os.path.getsize(base_name + to_ext(use[0]))
+    ins = [open(base_name + to_ext(i), "rb") for i in use]
+    outs = [open(base_name + to_ext(i), "wb") for i in missing]
+    try:
+        pos = 0
+        while pos < shard_size:
+            take = min(buffer_size, shard_size - pos)
+            buffers = []
+            for f in ins:
+                f.seek(pos)
+                raw = f.read(take)
+                if len(raw) < take:
+                    raw += b"\x00" * (take - len(raw))
+                buffers.append(np.frombuffer(raw, np.uint8))
+            rebuilt = _transform_buffers(encoder, coeff, buffers)
+            for o, buf in zip(outs, rebuilt):
+                o.write(np.asarray(buf, np.uint8).tobytes())
+            pos += take
+    finally:
+        for f in ins:
+            f.close()
+        for o in outs:
+            o.close()
+    return missing
+
+
+def write_dat_file(base_name: str, dat_size: int,
+                   large_block: int = LARGE_BLOCK_SIZE,
+                   small_block: int = SMALL_BLOCK_SIZE,
+                   buffer_size: int = 8 * 1024 * 1024) -> None:
+    """Reassemble <base>.dat from the 10 data shard files (ec.decode;
+    ec_decoder.go:150-191)."""
+    from .locate import locate_data
+    ins = []
+    for i in range(gf.DATA_SHARDS):
+        path = base_name + to_ext(i)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"data shard {i} missing; rebuild first: {path}")
+        ins.append(open(path, "rb"))
+    try:
+        with open(base_name + ".dat", "wb") as out:
+            pos = 0
+            while pos < dat_size:
+                take = min(buffer_size, dat_size - pos)
+                for iv in locate_data(large_block, small_block, dat_size,
+                                      pos, take):
+                    sid, soff = iv.to_shard_and_offset(large_block,
+                                                       small_block)
+                    ins[sid].seek(soff)
+                    out.write(ins[sid].read(iv.size))
+                pos += take
+    finally:
+        for f in ins:
+            f.close()
+
+
+def find_dat_file_size(base_name: str,
+                       version: int = t.CURRENT_VERSION) -> int:
+    """Logical volume size from the .ecx index (FindDatFileSize,
+    ec_decoder.go:47-69): max(offset + record length) over entries."""
+    size = 8  # superblock
+    with open(base_name + ".ecx", "rb") as f:
+        blob = f.read()
+    for key, off, esize in walk_index_blob(blob):
+        if esize == t.TOMBSTONE_FILE_SIZE:
+            continue
+        end = off + t.actual_size(esize, version)
+        size = max(size, end)
+    return size

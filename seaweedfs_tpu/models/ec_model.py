@@ -1,0 +1,88 @@
+"""Flagship jittable pipelines ("models") of the framework.
+
+The compute heart of the system is the RS(10,4) GF(256) shard transform;
+these are its end-to-end jittable forms, the analog of a model-forward /
+train-step in an ML framework:
+
+  encode_step   — forward: 10 data shards -> 14 shards (parity matmul)
+  rebuild_step  — recovery: any 10 shard rows -> requested lost rows
+  verify_step   — recompute parity and reduce a mismatch count
+
+Reference equivalents: reedsolomon Encode/Reconstruct at ec_encoder.go:192,
+264 and store_ec.go:322.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ec import gf
+from ..ec.encoder_jax import _apply_bitplanes
+
+
+def make_encode_step(use_pallas: bool | None = None):
+    """Returns fn(data (..., 10, n) uint8) -> (..., 14, n) uint8, jittable."""
+    consts = gf.bitplane_constants(gf.parity_matrix())
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+
+    if use_pallas:
+        from ..ops.gf256_pallas import gf256_matmul_pallas
+
+        def step(data):
+            parity = gf256_matmul_pallas(consts, data)
+            return jnp.concatenate([jnp.asarray(data, jnp.uint8), parity],
+                                   axis=-2)
+    else:
+        def step(data):
+            data = jnp.asarray(data, jnp.uint8)
+            parity = _apply_bitplanes(consts, data)
+            return jnp.concatenate([data, parity], axis=-2)
+    return step
+
+
+def make_rebuild_step(present_rows: list[int], want_rows: list[int],
+                      use_pallas: bool | None = None):
+    """Returns fn(shards (..., 10, n)) -> (..., len(want), n), jittable."""
+    coeff = gf.shard_rows(list(want_rows), list(present_rows))
+    consts = gf.bitplane_constants(coeff)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+
+    if use_pallas:
+        from ..ops.gf256_pallas import gf256_matmul_pallas
+
+        def step(shards):
+            return gf256_matmul_pallas(consts, shards)
+    else:
+        def step(shards):
+            return _apply_bitplanes(consts, jnp.asarray(shards, jnp.uint8))
+    return step
+
+
+def make_verify_step(use_pallas: bool | None = None):
+    """Returns fn(shards (..., 14, n)) -> scalar int32 mismatch count."""
+    consts = gf.bitplane_constants(gf.parity_matrix())
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+
+    def step(shards):
+        shards = jnp.asarray(shards, jnp.uint8)
+        data, parity = shards[..., :gf.DATA_SHARDS, :], \
+            shards[..., gf.DATA_SHARDS:, :]
+        if use_pallas:
+            from ..ops.gf256_pallas import gf256_matmul_pallas
+            want = gf256_matmul_pallas(consts, data)
+        else:
+            want = _apply_bitplanes(consts, data)
+        return jnp.sum((want != parity).astype(jnp.int32))
+    return step
+
+
+def example_inputs(batch: int = 0, n: int = 64 * 1024,
+                   seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    shape = (batch, gf.DATA_SHARDS, n) if batch else (gf.DATA_SHARDS, n)
+    return rng.integers(0, 256, shape).astype(np.uint8)

@@ -1,0 +1,53 @@
+"""Build-on-first-use for the native host library.
+
+The reference gets its host-side speed from amd64 assembly inside Go deps
+(klauspost/crc32, klauspost/reedsolomon); our host-side native surface is a
+small C library compiled locally with g++. No network, no pip.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libswtpu_native.so")
+_SOURCES = [os.path.join(_DIR, "crc32c.c"),
+            os.path.join(_DIR, "needle_map.c")]
+_lock = threading.Lock()
+_lib = None
+_failed = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    return any(os.path.exists(s) and os.path.getmtime(s) > so_mtime
+               for s in _SOURCES)
+
+
+def load() -> ctypes.CDLL | None:
+    """Return the native library, building it if needed; None if unavailable."""
+    global _lib, _failed
+    if _lib is not None or _failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            if _needs_build():
+                srcs = [s for s in _SOURCES if os.path.exists(s)]
+                cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _SO] + srcs
+                subprocess.run(cmd, check=True, capture_output=True,
+                               cwd=_DIR, timeout=120)
+            lib = ctypes.CDLL(_SO)
+            lib.swtpu_crc32c.restype = ctypes.c_uint32
+            lib.swtpu_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_char_p,
+                                         ctypes.c_size_t]
+            _lib = lib
+        except Exception:
+            _failed = True
+        return _lib

@@ -1,0 +1,230 @@
+"""Needle maps: needleId -> (offset, size) within one volume.
+
+Mirrors the reference's NeedleMapper contract (weed/storage/needle_map.go:
+21-33) and its .idx append-log persistence (16-byte big-endian entries:
+key(8) offset(4, units of 8B) size(4); tombstone size = 0xFFFFFFFF —
+needle_map.go:50, idx/walk.go:44).
+
+Kinds (needle_map.go:12-19): in-memory (the default; the reference's
+CompactMap becomes a plain dict here with an optional C++ fast map in
+native/), plus a read-only sorted-file map over .sdx used by tiered volumes
+and the EC .ecx index (needle_map_sorted_file.go).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+from dataclasses import dataclass
+from typing import Callable, Iterator
+
+from . import types as t
+
+_ENTRY = struct.Struct(">QII")  # key, offset/8, size
+
+
+@dataclass
+class NeedleValue:
+    key: int
+    offset: int  # actual byte offset
+    size: int
+
+
+def walk_index_blob(blob: bytes) -> Iterator[tuple[int, int, int]]:
+    """Yield (key, actual_offset, size) for each 16B entry (idx/walk.go:12)."""
+    n = len(blob) // t.NEEDLE_MAP_ENTRY_SIZE
+    for i in range(n):
+        key, off, size = _ENTRY.unpack_from(blob, i * t.NEEDLE_MAP_ENTRY_SIZE)
+        yield key, off * t.NEEDLE_PADDING_SIZE, size
+
+
+def walk_index_file(path: str,
+                    fn: Callable[[int, int, int], None]) -> None:
+    with open(path, "rb") as f:
+        blob = f.read()
+    for key, off, size in walk_index_blob(blob):
+        fn(key, off, size)
+
+
+class MemoryNeedleMap:
+    """In-memory map + .idx append log (needle_map_memory.go)."""
+
+    def __init__(self, index_path: str | None = None):
+        self.index_path = index_path
+        self._m: dict[int, NeedleValue] = {}
+        self._idx: io.BufferedWriter | None = None
+        self.deleted_count = 0
+        self.deleted_bytes = 0
+        self.file_count = 0
+        self.content_bytes = 0
+        self.max_file_key = 0
+        # (key, actual_offset, size) of the highest-offset logged record,
+        # tombstones included — the true .dat tail for integrity checking.
+        self.last_entry: tuple[int, int, int] | None = None
+        if index_path:
+            if os.path.exists(index_path):
+                self._load(index_path)
+            self._idx = open(index_path, "ab")
+
+    def _load(self, path: str) -> None:
+        def visit(key: int, offset: int, size: int) -> None:
+            self._apply(key, offset, size)
+        walk_index_file(path, visit)
+
+    def _apply(self, key: int, offset: int, size: int) -> None:
+        """Replay one idx entry into the in-memory state.
+
+        Deletes keep a tombstone NeedleValue (size = TOMBSTONE_FILE_SIZE) so
+        reads can distinguish "already deleted" from "never existed"
+        (volume_read_write.go:147-149). The logged offset of a delete is the
+        position of the tombstone record appended to .dat."""
+        self.max_file_key = max(self.max_file_key, key)
+        if offset > 0 and (self.last_entry is None
+                           or offset > self.last_entry[1]):
+            self.last_entry = (key, offset, size)
+        if offset > 0 and size != t.TOMBSTONE_FILE_SIZE:
+            old = self._m.get(key)
+            if old is not None and old.size != t.TOMBSTONE_FILE_SIZE:
+                self.deleted_count += 1
+                self.deleted_bytes += old.size
+            elif old is None:
+                self.file_count += 1
+            self.content_bytes += size
+            self._m[key] = NeedleValue(key, offset, size)
+        else:
+            old = self._m.get(key)
+            if old is not None and old.size != t.TOMBSTONE_FILE_SIZE:
+                self.deleted_count += 1
+                self.deleted_bytes += old.size
+            self._m[key] = NeedleValue(key, 0, t.TOMBSTONE_FILE_SIZE)
+
+    def _log(self, key: int, offset: int, size: int) -> None:
+        if self._idx is not None:
+            self._idx.write(_ENTRY.pack(
+                key, offset // t.NEEDLE_PADDING_SIZE, size))
+            self._idx.flush()
+
+    # -- NeedleMapper API --
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        self._apply(key, offset, size)
+        self._log(key, offset, size)
+
+    def get(self, key: int) -> NeedleValue | None:
+        return self._m.get(key)
+
+    def delete(self, key: int, offset: int) -> None:
+        """offset = position of the tombstone record appended to .dat."""
+        self._apply(key, offset, t.TOMBSTONE_FILE_SIZE)
+        self._log(key, offset, t.TOMBSTONE_FILE_SIZE)
+
+    def close(self) -> None:
+        if self._idx is not None:
+            self._idx.close()
+            self._idx = None
+
+    def destroy(self) -> None:
+        self.close()
+        if self.index_path and os.path.exists(self.index_path):
+            os.remove(self.index_path)
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+    def keys(self):
+        return self._m.keys()
+
+    def index_file_size(self) -> int:
+        if self.index_path and os.path.exists(self.index_path):
+            return os.path.getsize(self.index_path)
+        return 0
+
+    @property
+    def content_size(self) -> int:
+        return self.content_bytes
+
+    @property
+    def deleted_size(self) -> int:
+        return self.deleted_bytes
+
+
+class SortedFileNeedleMap:
+    """Binary search over a sorted 16B-entry index (.sdx/.ecx).
+
+    Reference: needle_map_sorted_file.go, ec_volume.go:203-228
+    (SearchNeedleFromSortedIndex). Open writable for the EC delete path,
+    which tombstones entries in place (MarkNeedleDeleted,
+    ec_volume_delete.go:13-25).
+    """
+
+    def __init__(self, path: str, writable: bool = False):
+        self.path = path
+        self.writable = writable
+        self._f = open(path, "r+b" if writable else "rb")
+        self._size = os.path.getsize(path)
+        assert self._size % t.NEEDLE_MAP_ENTRY_SIZE == 0, path
+        self.count = self._size // t.NEEDLE_MAP_ENTRY_SIZE
+
+    def _entry(self, i: int) -> tuple[int, int, int]:
+        self._f.seek(i * t.NEEDLE_MAP_ENTRY_SIZE)
+        key, off, size = _ENTRY.unpack(self._f.read(t.NEEDLE_MAP_ENTRY_SIZE))
+        return key, off * t.NEEDLE_PADDING_SIZE, size
+
+    def locate(self, key: int) -> int | None:
+        """Entry index of key, or None."""
+        lo, hi = 0, self.count - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            k, _, _ = self._entry(mid)
+            if k == key:
+                return mid
+            if k < key:
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return None
+
+    def get_raw(self, key: int) -> tuple[int, int] | None:
+        """(actual_offset, size) incl. tombstone sizes, or None if absent."""
+        i = self.locate(key)
+        if i is None:
+            return None
+        _, off, size = self._entry(i)
+        return off, size
+
+    def get(self, key: int) -> NeedleValue | None:
+        raw = self.get_raw(key)
+        if raw is None or raw[1] == t.TOMBSTONE_FILE_SIZE:
+            return None
+        return NeedleValue(key, raw[0], raw[1])
+
+    def mark_deleted(self, key: int) -> bool:
+        """Overwrite the entry's size with the tombstone marker in place."""
+        assert self.writable, self.path
+        i = self.locate(key)
+        if i is None:
+            return False
+        self._f.seek(i * t.NEEDLE_MAP_ENTRY_SIZE + t.NEEDLE_ID_SIZE
+                     + t.OFFSET_SIZE)
+        self._f.write(t.TOMBSTONE_FILE_SIZE.to_bytes(4, "big"))
+        self._f.flush()
+        return True
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def write_sorted_index(entries: list[tuple[int, int, int]], path: str) -> None:
+    """Write (key, actual_offset, size) entries as a sorted index file.
+
+    Last entry per key wins (matching WriteSortedFileFromIdx semantics,
+    ec_encoder.go:26-50: deleted needles keep their tombstone size).
+    """
+    latest: dict[int, tuple[int, int]] = {}
+    for key, off, size in entries:
+        latest[key] = (off, size)
+    with open(path, "wb") as f:
+        for key in sorted(latest):
+            off, size = latest[key]
+            f.write(_ENTRY.pack(key, off // t.NEEDLE_PADDING_SIZE, size))

@@ -1,0 +1,164 @@
+"""Core storage scalar types and binary constants.
+
+Semantics follow the reference's weed/storage/types/ (needle_types.go,
+needle_id_type.go, offset_4bytes.go) and weed/storage/needle/
+(volume_ttl.go, volume_id.go, file_id.go): big-endian on-disk integers,
+8-byte-aligned needle offsets stored as 4-byte multiples-of-8 (32GB max
+volume; the 5-byte build is a config knob here, not a build tag).
+"""
+
+from __future__ import annotations
+
+import re
+import secrets
+from dataclasses import dataclass
+
+COOKIE_SIZE = 4
+NEEDLE_ID_SIZE = 8
+SIZE_SIZE = 4
+OFFSET_SIZE = 4
+NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+TIMESTAMP_SIZE = 8
+NEEDLE_PADDING_SIZE = 8
+NEEDLE_CHECKSUM_SIZE = 4
+TOMBSTONE_FILE_SIZE = 0xFFFFFFFF
+
+# Needle format versions (weed/storage/needle/volume_version.go)
+VERSION1 = 1
+VERSION2 = 2
+VERSION3 = 3
+CURRENT_VERSION = VERSION3
+
+
+def random_cookie() -> int:
+    return secrets.randbits(32)
+
+
+def offset_to_bytes(actual_offset: int) -> bytes:
+    """actual byte offset -> 4-byte stored offset (units of 8 bytes)."""
+    assert actual_offset % NEEDLE_PADDING_SIZE == 0, actual_offset
+    return (actual_offset // NEEDLE_PADDING_SIZE).to_bytes(4, "big")
+
+
+def offset_from_bytes(b: bytes) -> int:
+    """4-byte stored offset -> actual byte offset."""
+    return int.from_bytes(b[:4], "big") * NEEDLE_PADDING_SIZE
+
+
+def padding_length(needle_size: int, version: int) -> int:
+    if version == VERSION3:
+        used = NEEDLE_HEADER_SIZE + needle_size + NEEDLE_CHECKSUM_SIZE + TIMESTAMP_SIZE
+    else:
+        used = NEEDLE_HEADER_SIZE + needle_size + NEEDLE_CHECKSUM_SIZE
+    return (NEEDLE_PADDING_SIZE - used % NEEDLE_PADDING_SIZE) % NEEDLE_PADDING_SIZE
+
+
+def actual_size(needle_size: int, version: int) -> int:
+    """Total on-disk record length for a needle body size."""
+    if version == VERSION3:
+        base = NEEDLE_HEADER_SIZE + needle_size + NEEDLE_CHECKSUM_SIZE + TIMESTAMP_SIZE
+    else:
+        base = NEEDLE_HEADER_SIZE + needle_size + NEEDLE_CHECKSUM_SIZE
+    return base + padding_length(needle_size, version)
+
+
+# --------------------------------------------------------------------------
+# TTL (volume_ttl.go): 2 bytes, count + unit.
+# --------------------------------------------------------------------------
+
+TTL_EMPTY = 0
+TTL_MINUTE = 1
+TTL_HOUR = 2
+TTL_DAY = 3
+TTL_WEEK = 4
+TTL_MONTH = 5
+TTL_YEAR = 6
+
+_UNIT_CHARS = {"m": TTL_MINUTE, "h": TTL_HOUR, "d": TTL_DAY,
+               "w": TTL_WEEK, "M": TTL_MONTH, "y": TTL_YEAR}
+_CHAR_UNITS = {v: k for k, v in _UNIT_CHARS.items()}
+_UNIT_MINUTES = {TTL_EMPTY: 0, TTL_MINUTE: 1, TTL_HOUR: 60, TTL_DAY: 1440,
+                 TTL_WEEK: 10080, TTL_MONTH: 43200, TTL_YEAR: 525600}
+
+
+@dataclass(frozen=True)
+class TTL:
+    count: int = 0
+    unit: int = TTL_EMPTY
+
+    @classmethod
+    def parse(cls, s: str | None) -> "TTL":
+        if not s:
+            return cls()
+        m = re.fullmatch(r"(\d+)([mhdwMy]?)", s)
+        if not m:
+            raise ValueError(f"invalid ttl: {s!r}")
+        count = int(m.group(1))
+        unit = _UNIT_CHARS.get(m.group(2) or "m", TTL_MINUTE)
+        if not 0 <= count <= 255:
+            raise ValueError(f"ttl count out of range: {s!r}")
+        return cls(count, unit if count else TTL_EMPTY)
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "TTL":
+        if b[0] == 0 and b[1] == 0:
+            return cls()
+        return cls(b[0], b[1])
+
+    @classmethod
+    def from_uint32(cls, v: int) -> "TTL":
+        return cls.from_bytes(bytes([(v >> 8) & 0xFF, v & 0xFF]))
+
+    def to_bytes(self) -> bytes:
+        return bytes([self.count & 0xFF, self.unit & 0xFF])
+
+    def to_uint32(self) -> int:
+        if self.count == 0:
+            return 0
+        return (self.count << 8) | self.unit
+
+    @property
+    def minutes(self) -> int:
+        return self.count * _UNIT_MINUTES.get(self.unit, 0)
+
+    def __str__(self) -> str:
+        if self.count == 0:
+            return ""
+        return f"{self.count}{_CHAR_UNITS.get(self.unit, 'm')}"
+
+
+# --------------------------------------------------------------------------
+# FileId: "volumeId,needleKeyHex+cookieHex" (file_id.go:60-72)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class FileId:
+    volume_id: int
+    key: int
+    cookie: int
+
+    def __str__(self) -> str:
+        raw = self.key.to_bytes(NEEDLE_ID_SIZE, "big") + \
+            self.cookie.to_bytes(COOKIE_SIZE, "big")
+        stripped = raw.lstrip(b"\x00")
+        if not stripped:
+            stripped = b"\x00"
+        return f"{self.volume_id},{stripped.hex()}"
+
+    @classmethod
+    def parse(cls, fid: str) -> "FileId":
+        if "," not in fid:
+            raise ValueError(f"wrong fid format: {fid!r}")
+        vid_s, key_cookie = fid.split(",", 1)
+        # needle deletion replication appends "_<count>" suffixes; strip.
+        key_cookie = key_cookie.split("_")[0]
+        if len(key_cookie) <= 8:
+            raise ValueError(f"key-cookie too short: {fid!r}")
+        if len(key_cookie) % 2 == 1:
+            key_cookie = "0" + key_cookie
+        raw = bytes.fromhex(key_cookie)
+        return cls(volume_id=int(vid_s),
+                   key=int.from_bytes(raw[:-COOKIE_SIZE], "big"),
+                   cookie=int.from_bytes(raw[-COOKIE_SIZE:], "big"))

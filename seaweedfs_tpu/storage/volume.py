@@ -1,0 +1,260 @@
+"""Volume: one append-only .dat blob file + .idx needle index.
+
+Reference semantics: weed/storage/volume.go:21-45,
+volume_read_write.go:66-172 (append-only writes, tombstone deletes, O(1)
+reads via the needle map), volume_loading.go (load + integrity check),
+volume_checking.go:14-78 (verify the last idx entry matches the data tail).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from . import types as t
+from .needle import Needle, NeedleError
+from .needle_map import MemoryNeedleMap
+from .super_block import SuperBlock, ReplicaPlacement
+
+
+class VolumeError(Exception):
+    pass
+
+
+class NotFound(VolumeError):
+    pass
+
+
+class AlreadyDeleted(VolumeError):
+    pass
+
+
+@dataclass
+class VolumeStat:
+    file_count: int
+    deleted_count: int
+    deleted_bytes: int
+    size: int
+    read_only: bool
+
+
+class Volume:
+    """One volume on local disk: <dir>/<collection_><vid>.dat / .idx."""
+
+    def __init__(self, dirname: str, collection: str, vid: int,
+                 replica_placement: ReplicaPlacement | None = None,
+                 ttl: t.TTL | None = None,
+                 preallocate: int = 0,
+                 create_if_missing: bool = True):
+        self.dir = dirname
+        self.collection = collection
+        self.vid = vid
+        self.read_only = False
+        self.last_append_at_ns = 0
+        self.last_modified_ts = 0
+        self._lock = threading.RLock()
+
+        base = self.file_name()
+        dat_path = base + ".dat"
+        exists = os.path.exists(dat_path)
+        if not exists and not create_if_missing:
+            raise VolumeError(f"volume file missing: {dat_path}")
+
+        if exists:
+            self._dat = open(dat_path, "r+b")
+            sb_raw = self._dat.read(8)
+            if len(sb_raw) < 8:
+                raise VolumeError(f"corrupt superblock in {dat_path}")
+            self.super_block = SuperBlock.from_bytes(sb_raw)
+        else:
+            os.makedirs(dirname, exist_ok=True)
+            self.super_block = SuperBlock(
+                replica_placement=replica_placement or ReplicaPlacement(),
+                ttl=ttl or t.TTL())
+            self._dat = open(dat_path, "w+b")
+            self._dat.write(self.super_block.to_bytes())
+            self._dat.flush()
+            if preallocate:
+                try:
+                    os.posix_fallocate(self._dat.fileno(), 0, preallocate)
+                except OSError:
+                    pass
+        self.nm = MemoryNeedleMap(base + ".idx")
+        self._check_integrity()
+
+    # ---- naming ----
+
+    def file_name(self) -> str:
+        name = f"{self.collection}_{self.vid}" if self.collection else str(self.vid)
+        return os.path.join(self.dir, name)
+
+    @property
+    def version(self) -> int:
+        return self.super_block.version
+
+    @property
+    def ttl(self) -> t.TTL:
+        return self.super_block.ttl
+
+    # ---- integrity (volume_checking.go:14-37) ----
+
+    def _check_integrity(self) -> None:
+        """Verify the last logged idx entry (tombstones included) points at
+        a parseable needle at the data tail; truncate a torn tail write."""
+        size = self.data_size()
+        last = self.nm.last_entry
+        if last is None:
+            return
+        key, offset, logged_size = last
+        # tombstone records are empty-body needles on disk
+        body_size = 0 if logged_size == t.TOMBSTONE_FILE_SIZE else logged_size
+        expected_end = offset + t.actual_size(body_size, self.version)
+        if expected_end > size:
+            raise VolumeError(
+                f"volume {self.vid}: index points past data end "
+                f"({expected_end} > {size})")
+        try:
+            n = self._read_at(offset, body_size)
+        except NeedleError as e:
+            raise VolumeError(f"volume {self.vid}: tail needle corrupt: {e}")
+        if n.id != key:
+            raise VolumeError(
+                f"volume {self.vid}: tail needle key mismatch "
+                f"{n.id:x} != {key:x}")
+        if expected_end < size:
+            # torn write past the last logged record: truncate it away
+            self._dat.truncate(expected_end)
+
+    # ---- I/O core ----
+
+    def data_size(self) -> int:
+        self._dat.seek(0, os.SEEK_END)
+        return self._dat.tell()
+
+    def _read_at(self, offset: int, size: int) -> Needle:
+        self._dat.seek(offset)
+        blob = self._dat.read(t.actual_size(size, self.version))
+        return Needle.from_bytes(blob, self.version)
+
+    def write_needle(self, n: Needle) -> tuple[int, int]:
+        """Append a needle; returns (offset, size).
+
+        volume_read_write.go:66-113: inherit volume TTL, verify existing
+        cookie on overwrite, append, nm.Put.
+        """
+        with self._lock:
+            if self.read_only:
+                raise VolumeError(f"volume {self.vid} is read-only")
+            if n.ttl.count == 0 and self.ttl.count != 0:
+                n.ttl = self.ttl
+            nv = self.nm.get(n.id)
+            if (nv is not None and nv.offset > 0
+                    and nv.size != t.TOMBSTONE_FILE_SIZE):
+                existing = self._read_at(nv.offset, nv.size)
+                if existing.cookie != n.cookie:
+                    raise VolumeError(
+                        f"mismatching cookie {n.cookie:x} for needle {n.id:x}")
+            n.append_at_ns = time.time_ns()
+            offset = self.data_size()
+            blob = n.to_bytes(self.version)
+            self._dat.seek(offset)
+            self._dat.write(blob)
+            self._dat.flush()
+            self.last_append_at_ns = n.append_at_ns
+            if nv is None or nv.offset < offset:
+                self.nm.put(n.id, offset, n.size)
+            if n.last_modified > self.last_modified_ts:
+                self.last_modified_ts = n.last_modified
+            return offset, n.size
+
+    def delete_needle(self, n: Needle) -> int:
+        """Tombstone delete; returns reclaimed byte count
+        (volume_read_write.go:115-136)."""
+        with self._lock:
+            if self.read_only:
+                raise VolumeError(f"volume {self.vid} is read-only")
+            nv = self.nm.get(n.id)
+            if nv is None or nv.size == t.TOMBSTONE_FILE_SIZE:
+                return 0
+            size = nv.size
+            n.data = b""
+            n.append_at_ns = time.time_ns()
+            offset = self.data_size()
+            self._dat.seek(offset)
+            self._dat.write(n.to_bytes(self.version))
+            self._dat.flush()
+            self.last_append_at_ns = n.append_at_ns
+            self.nm.delete(n.id, offset)
+            return size
+
+    def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
+        """O(1) read: nm.Get + one ReadAt (volume_read_write.go:139-172)."""
+        with self._lock:
+            nv = self.nm.get(needle_id)
+            if nv is not None and nv.size == t.TOMBSTONE_FILE_SIZE:
+                raise AlreadyDeleted(f"needle {needle_id:x} deleted")
+            if nv is None or nv.offset == 0:
+                raise NotFound(f"needle {needle_id:x} not found")
+            n = self._read_at(nv.offset, nv.size)
+        if cookie is not None and n.cookie != cookie:
+            raise NotFound(f"cookie mismatch for needle {needle_id:x}")
+        if n.has_expired():
+            raise NotFound(f"needle {needle_id:x} expired")
+        return n
+
+    # ---- scanning (volume_read_write.go:174-230 ScanVolumeFile) ----
+
+    def scan(self, visit) -> None:
+        """visit(needle, offset) over every record incl. tombstones."""
+        size = self.data_size()
+        offset = 8  # past the superblock
+        while offset + t.NEEDLE_HEADER_SIZE <= size:
+            self._dat.seek(offset)
+            header = self._dat.read(t.NEEDLE_HEADER_SIZE)
+            if len(header) < t.NEEDLE_HEADER_SIZE:
+                break
+            body_size = int.from_bytes(header[12:16], "big")
+            rec_len = t.actual_size(body_size, self.version)
+            self._dat.seek(offset)
+            blob = self._dat.read(rec_len)
+            if len(blob) < rec_len:
+                break
+            n = Needle.from_bytes(blob, self.version, check_crc=False)
+            visit(n, offset)
+            offset += rec_len
+
+    # ---- stats / lifecycle ----
+
+    def stat(self) -> VolumeStat:
+        return VolumeStat(
+            file_count=self.nm.file_count,
+            deleted_count=self.nm.deleted_count,
+            deleted_bytes=self.nm.deleted_size,
+            size=self.data_size(),
+            read_only=self.read_only,
+        )
+
+    def garbage_level(self) -> float:
+        size = self.data_size()
+        if size <= 8:
+            return 0.0
+        return self.nm.deleted_size / size
+
+    def is_full(self, volume_size_limit: int) -> bool:
+        return self.data_size() >= volume_size_limit
+
+    def close(self) -> None:
+        with self._lock:
+            self.nm.close()
+            self._dat.close()
+
+    def destroy(self) -> None:
+        with self._lock:
+            self.nm.destroy()
+            self._dat.close()
+            for ext in (".dat",):
+                p = self.file_name() + ext
+                if os.path.exists(p):
+                    os.remove(p)

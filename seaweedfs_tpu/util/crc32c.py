@@ -1,0 +1,55 @@
+"""CRC32-Castagnoli with the reference's stored-value masking.
+
+Matches weed/storage/needle/crc.go: the stored checksum is the "masked"
+rotation used by snappy/leveldb: rotl(crc, 17) + 0xa282ead8. Raw CRC is the
+reflected Castagnoli polynomial, same as klauspost/crc32's table.
+
+Fast path is the native C library (SSE4.2 hardware CRC); fallback is a
+pure-Python slicing table (slow, correctness-only).
+"""
+
+from __future__ import annotations
+
+from ..native.build import load as _load_native
+
+_POLY = 0x82F63B78
+
+
+def _make_table() -> list[int]:
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (_POLY ^ (c >> 1)) if (c & 1) else (c >> 1)
+        table.append(c)
+    return table
+
+
+_TABLE = _make_table()
+
+
+def _crc32c_py(crc: int, data: bytes) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def crc32c(data: bytes | bytearray | memoryview, crc: int = 0) -> int:
+    """Raw CRC32C of data, continuing from crc."""
+    lib = _load_native()
+    if lib is not None:
+        data = bytes(data) if not isinstance(data, bytes) else data
+        return lib.swtpu_crc32c(crc, data, len(data))
+    return _crc32c_py(crc, bytes(data))
+
+
+def masked(crc: int) -> int:
+    """Stored-checksum masking (crc.go Value())."""
+    crc &= 0xFFFFFFFF
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def checksum_value(data: bytes | bytearray | memoryview) -> int:
+    """Masked CRC32C as written into a needle footer."""
+    return masked(crc32c(data))
