@@ -88,8 +88,7 @@ class EcVolume:
     @property
     def shard_size(self) -> int:
         for f in self.shards.values():
-            f.seek(0, os.SEEK_END)
-            return f.tell()
+            return os.fstat(f.fileno()).st_size
         return 0
 
     @property
@@ -106,8 +105,8 @@ class EcVolume:
         (readOneEcShardInterval, store_ec.go:178-209)."""
         f = self.shards.get(sid)
         if f is not None:
-            f.seek(offset)
-            data = f.read(size)
+            # pread: position-independent, safe under concurrent readers
+            data = os.pread(f.fileno(), size, offset)
             if len(data) == size:
                 return data
             return data + b"\x00" * (size - len(data))
@@ -128,8 +127,7 @@ class EcVolume:
             data: bytes | None = None
             f = self.shards.get(sid)
             if f is not None:
-                f.seek(offset)
-                raw = f.read(size)
+                raw = os.pread(f.fileno(), size, offset)
                 data = raw + b"\x00" * (size - len(raw))
             elif self.fetch_remote is not None:
                 data = self.fetch_remote(sid, offset, size)

@@ -1,0 +1,32 @@
+"""Monotonic needle-key sequencer (reference: weed/sequence/sequence.go,
+memory_sequencer.go; the etcd-backed variant maps to a pluggable subclass).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class MemorySequencer:
+    """In-memory monotonic allocator; synced up from volume-server
+    heartbeats reporting their max file key (master_grpc_server.go)."""
+
+    def __init__(self, start: int = 1):
+        self._counter = max(1, start)
+        self._lock = threading.Lock()
+
+    def next_file_id(self, count: int = 1) -> int:
+        """Allocate `count` consecutive ids; returns the first."""
+        with self._lock:
+            first = self._counter
+            self._counter += count
+            return first
+
+    def set_max(self, seen: int) -> None:
+        with self._lock:
+            if seen + 1 > self._counter:
+                self._counter = seen + 1
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._counter

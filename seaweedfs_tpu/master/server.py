@@ -1,0 +1,342 @@
+"""Master server: cluster control plane.
+
+Reference: weed/server/master_server.go (HTTP /dir/assign, /dir/lookup,
+status), master_grpc_server.go (heartbeat stream -> topology sync + pubsub
+of location deltas), master_server_handlers.go:96-137 (assign + on-demand
+volume growth). gRPC streams become HTTP POST heartbeats + an SSE watch
+stream over the asyncio mesh.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from aiohttp import web
+import aiohttp
+
+from ..pb import messages as pb
+from ..storage import types as t
+from ..storage.super_block import ReplicaPlacement
+from ..topology.layout import (LayoutKey, PlacementError, VolumeLayout,
+                               find_empty_slots)
+from ..topology.tree import DataNode, Topology
+from .sequence import MemorySequencer
+
+
+class MasterServer:
+    def __init__(self, ip: str = "127.0.0.1", port: int = 9333,
+                 volume_size_limit_mb: int = 30_000,
+                 default_replication: str = "000",
+                 pulse_seconds: float = 5.0,
+                 garbage_threshold: float = 0.3):
+        self.ip = ip
+        self.port = port
+        self.volume_size_limit = volume_size_limit_mb * 1024 * 1024
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.topo = Topology(pulse_seconds=pulse_seconds)
+        self.seq = MemorySequencer()
+        self.layouts: dict[LayoutKey, VolumeLayout] = {}
+        self._watchers: list[asyncio.Queue] = []
+        self._runner: web.AppRunner | None = None
+        self._site: web.TCPSite | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._http: aiohttp.ClientSession | None = None
+        self.app = self._build_app()
+
+    # ------------------------------------------------------------------
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        app.router.add_route("*", "/dir/assign", self.h_assign)
+        app.router.add_route("*", "/dir/lookup", self.h_lookup)
+        app.router.add_get("/dir/status", self.h_dir_status)
+        app.router.add_get("/cluster/status", self.h_cluster_status)
+        app.router.add_post("/cluster/heartbeat", self.h_heartbeat)
+        app.router.add_get("/cluster/watch", self.h_watch)
+        app.router.add_get("/stats/health", self.h_health)
+        app.router.add_route("*", "/vol/grow", self.h_grow)
+        app.router.add_route("*", "/col/delete", self.h_collection_delete)
+        app.router.add_get("/vol/volumes", self.h_volumes)
+        app.router.add_get("/vol/ec_lookup", self.h_ec_lookup)
+        return app
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    async def start(self) -> None:
+        self._http = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=30))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.ip, self.port)
+        await self._site.start()
+        if self.port == 0:
+            self.port = self._site._server.sockets[0].getsockname()[1]
+        self._tasks.append(asyncio.create_task(self._liveness_loop()))
+
+    async def stop(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        if self._http:
+            await self._http.close()
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ---- layouts ----
+
+    def _layout(self, collection: str, replication: str,
+                ttl: str) -> VolumeLayout:
+        replication = str(ReplicaPlacement.parse(
+            replication or self.default_replication))
+        key = LayoutKey(collection, replication, str(t.TTL.parse(ttl)))
+        lay = self.layouts.get(key)
+        if lay is None:
+            lay = VolumeLayout(key, self.volume_size_limit)
+            self.layouts[key] = lay
+        return lay
+
+    def _refresh_writable(self, node: DataNode) -> None:
+        for m in node.volumes.values():
+            rp = ReplicaPlacement.from_byte(m.replica_placement)
+            ttl = str(t.TTL.from_uint32(m.ttl))
+            lay = self._layout(m.collection, str(rp), ttl)
+            writable = (not m.read_only
+                        and m.size < self.volume_size_limit)
+            lay.set_writable(m.id, writable)
+
+    # ---- handlers ----
+
+    async def h_health(self, req: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def h_heartbeat(self, req: web.Request) -> web.Response:
+        hb = pb.Heartbeat.from_dict(await req.json())
+        node = self.topo.register_heartbeat(hb)
+        self.seq.set_max(hb.max_file_key)
+        self._refresh_writable(node)
+        # publish location deltas to watchers (KeepConnected analog)
+        if hb.new_volumes or hb.deleted_volumes or hb.new_ec_shards \
+                or hb.deleted_ec_shards:
+            self._publish({
+                "url": node.url, "public_url": node.public_url,
+                "new_vids": sorted({m.id for m in hb.new_volumes}
+                                   | {m.id for m in hb.new_ec_shards}),
+                "deleted_vids": sorted({m.id for m in hb.deleted_volumes}
+                                       | {m.id for m in hb.deleted_ec_shards}),
+            })
+        return web.json_response({
+            "volume_size_limit": self.volume_size_limit,
+            "leader": self.url,
+        })
+
+    async def h_assign(self, req: web.Request) -> web.Response:
+        q = req.query
+        count = int(q.get("count", 1) or 1)
+        collection = q.get("collection", "")
+        replication = q.get("replication", "") or self.default_replication
+        ttl = q.get("ttl", "")
+        data_center = q.get("dataCenter", "")
+        try:
+            rp = ReplicaPlacement.parse(replication)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+
+        lay = self._layout(collection, replication, ttl)
+        vid = lay.pick_for_write(self.topo, rp.copy_count)
+        if vid is None:
+            try:
+                await self._grow(lay, rp, collection, replication, ttl,
+                                 data_center)
+            except PlacementError as e:
+                return web.json_response({"error": str(e)}, status=500)
+            vid = lay.pick_for_write(self.topo, rp.copy_count)
+            if vid is None:
+                return web.json_response(
+                    {"error": "no writable volumes after growth"}, status=500)
+        key = self.seq.next_file_id(count)
+        fid = str(t.FileId(vid, key, t.random_cookie()))
+        nodes = self.topo.lookup(vid)
+        node = nodes[0]
+        return web.json_response({
+            "fid": fid, "url": node.url, "publicUrl": node.public_url,
+            "count": count,
+        })
+
+    async def _grow(self, lay: VolumeLayout, rp: ReplicaPlacement,
+                    collection: str, replication: str, ttl: str,
+                    data_center: str) -> None:
+        """AutomaticGrowByType: place + AllocateVolume on each target
+        (volume_growth.go:204-230, allocate_volume.go)."""
+        nodes = find_empty_slots(self.topo, rp, data_center or None)
+        vid = self.topo.next_volume_id()
+        for n in nodes:
+            async with self._http.post(
+                    f"http://{n.url}/admin/volume/allocate",
+                    params={"volume": str(vid), "collection": collection,
+                            "replication": replication, "ttl": ttl}) as resp:
+                if resp.status != 200:
+                    raise PlacementError(
+                        f"allocate vid {vid} on {n.url}: "
+                        f"{await resp.text()}")
+            m = pb.VolumeInformationMessage(
+                id=vid, collection=collection,
+                replica_placement=rp.to_byte(),
+                ttl=t.TTL.parse(ttl).to_uint32())
+            n.volumes[m.id] = m
+            self.topo.register_volume(m, n)
+        lay.set_writable(vid, True)
+
+    async def h_lookup(self, req: web.Request) -> web.Response:
+        q = req.query
+        vid_s = q.get("volumeId", "") or q.get("fileId", "")
+        if "," in vid_s:
+            vid_s = vid_s.split(",")[0]
+        try:
+            vid = int(vid_s)
+        except ValueError:
+            return web.json_response(
+                {"error": f"unknown volumeId {vid_s!r}"}, status=400)
+        nodes = self.topo.lookup(vid)
+        if not nodes:
+            return web.json_response(
+                {"volumeId": vid_s, "error": "volume id not found"},
+                status=404)
+        return web.json_response({
+            "volumeId": vid_s,
+            "locations": [{"url": n.url, "publicUrl": n.public_url}
+                          for n in nodes],
+        })
+
+    async def h_dir_status(self, req: web.Request) -> web.Response:
+        dcs = []
+        for dc in self.topo.data_centers.values():
+            racks = []
+            for r in dc.racks.values():
+                racks.append({
+                    "id": r.id,
+                    "nodes": [{
+                        "id": n.id, "url": n.url, "publicUrl": n.public_url,
+                        "volumes": len(n.volumes),
+                        "ecShards": n.ec_shard_count(),
+                        "max": n.max_volume_count,
+                    } for n in r.nodes.values()],
+                })
+            dcs.append({"id": dc.id, "racks": racks})
+        return web.json_response({
+            "topology": {"datacenters": dcs,
+                         "max_volume_id": self.topo.max_volume_id},
+            "version": "seaweedfs_tpu 0.1",
+        })
+
+    async def h_volumes(self, req: web.Request) -> web.Response:
+        """VolumeList analog: every volume + EC shard set with locations."""
+        out = []
+        for node in self.topo.all_nodes():
+            out.append({
+                "url": node.url, "publicUrl": node.public_url,
+                "dataCenter": node.rack.data_center.id if node.rack else "",
+                "rack": node.rack.id if node.rack else "",
+                "maxVolumes": node.max_volume_count,
+                "freeSlots": node.free_space(),
+                "volumes": [m.to_dict() for m in node.volumes.values()],
+                "ecShards": [m.to_dict() for m in node.ec_shards.values()],
+            })
+        return web.json_response({"nodes": out})
+
+    async def h_ec_lookup(self, req: web.Request) -> web.Response:
+        """vid -> {shard_id: [urls]} (LookupEcVolume, topology_ec.go:97-133)."""
+        vid = int(req.query["volumeId"])
+        by_shard = self.topo.ec_shard_locations.get(vid)
+        if not by_shard:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({
+            "volumeId": vid,
+            "shards": {str(sid): [n.url for n in nodes]
+                       for sid, nodes in by_shard.items()},
+        })
+
+    async def h_cluster_status(self, req: web.Request) -> web.Response:
+        return web.json_response({
+            "isLeader": True, "leader": self.url, "peers": []})
+
+    async def h_grow(self, req: web.Request) -> web.Response:
+        q = req.query
+        collection = q.get("collection", "")
+        replication = q.get("replication", "") or self.default_replication
+        ttl = q.get("ttl", "")
+        count = int(q.get("count", 1) or 1)
+        rp = ReplicaPlacement.parse(replication)
+        lay = self._layout(collection, replication, ttl)
+        grown = 0
+        for _ in range(count):
+            try:
+                await self._grow(lay, rp, collection, replication, ttl,
+                                 q.get("dataCenter", ""))
+                grown += 1
+            except PlacementError as e:
+                return web.json_response(
+                    {"error": str(e), "count": grown}, status=500)
+        return web.json_response({"count": grown})
+
+    async def h_collection_delete(self, req: web.Request) -> web.Response:
+        collection = req.query.get("collection", "")
+        deleted = []
+        for node in self.topo.all_nodes():
+            vids = [m.id for m in node.volumes.values()
+                    if m.collection == collection]
+            for vid in vids:
+                async with self._http.post(
+                        f"http://{node.url}/admin/volume/delete",
+                        params={"volume": str(vid)}) as resp:
+                    await resp.read()
+                deleted.append(vid)
+        return web.json_response({"deleted": sorted(set(deleted))})
+
+    # ---- watch stream (KeepConnected pubsub, master_grpc_server.go:181) ----
+
+    def _publish(self, update: dict) -> None:
+        for q in self._watchers:
+            q.put_nowait(update)
+
+    async def h_watch(self, req: web.Request) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"})
+        await resp.prepare(req)
+        # initial full state (snapshot: heartbeats mutate these dicts)
+        for vid, locs in list(self.topo.volume_locations.items()):
+            for n in list(locs.values()):
+                await resp.write(json.dumps({
+                    "url": n.url, "public_url": n.public_url,
+                    "new_vids": [vid], "deleted_vids": []}).encode() + b"\n")
+        q: asyncio.Queue = asyncio.Queue()
+        self._watchers.append(q)
+        try:
+            while True:
+                update = await q.get()
+                await resp.write(json.dumps(update).encode() + b"\n")
+        except (asyncio.CancelledError, ConnectionResetError):
+            pass
+        finally:
+            self._watchers.remove(q)
+        return resp
+
+    # ---- liveness sweep (topology_event_handling.go:13-21) ----
+
+    async def _liveness_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.topo.pulse_seconds)
+            for node in self.topo.dead_nodes():
+                vids = self.topo.unregister_node(node)
+                for lay in self.layouts.values():
+                    for vid in vids:
+                        # volumes that lost replicas below quorum stop
+                        # being writable until re-registered
+                        if vid in lay.writable and not self.topo.lookup(vid):
+                            lay.set_writable(vid, False)
+                self._publish({"url": node.url,
+                               "public_url": node.public_url,
+                               "new_vids": [],
+                               "deleted_vids": sorted(set(vids))})

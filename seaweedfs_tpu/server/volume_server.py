@@ -1,0 +1,532 @@
+"""Volume server: data-plane node.
+
+Reference: weed/server/volume_server.go:18-35 (public needle HTTP +
+admin RPC), volume_server_handlers_read.go:30-169 (GET incl. cookie/TTL
+checks, mime, etag), volume_server_handlers_write.go:19-73 (POST/DELETE w/
+replication), volume_grpc_client_to_master.go:23-177 (heartbeat loop w/
+leader chasing), volume_grpc_erasure_coding.go (EC shard lifecycle RPCs),
+topology/store_replicate.go (replica fan-out).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gzip
+import os
+import time
+
+import aiohttp
+from aiohttp import web
+
+from ..ec import gf
+from ..ec import pipeline as ecpl
+from ..pb import messages as pb
+from ..storage import types as t
+from ..storage.needle import FLAG_GZIP, FLAG_HAS_LAST_MODIFIED, CrcMismatch, Needle
+from ..storage.store import Store
+from ..storage.volume import AlreadyDeleted, NotFound, VolumeError
+
+
+class VolumeServer:
+    def __init__(self, store: Store, master_url: str,
+                 ip: str = "127.0.0.1", port: int = 8080,
+                 data_center: str = "", rack: str = "",
+                 pulse_seconds: float = 5.0,
+                 read_redirect: bool = True):
+        self.store = store
+        self.master_url = master_url
+        self.ip = ip
+        self.port = port
+        self.data_center = data_center
+        self.rack = rack
+        self.pulse_seconds = pulse_seconds
+        self.read_redirect = read_redirect
+        self.volume_size_limit = 30_000 * 1024 * 1024
+        self._runner: web.AppRunner | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._http: aiohttp.ClientSession | None = None
+        self.app = self._build_app()
+        store.fetch_remote_shard = None  # wired after start (needs loop)
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=1024 * 1024 * 1024)
+        # admin API (gRPC-analog)
+        app.router.add_post("/admin/volume/allocate", self.h_allocate)
+        app.router.add_post("/admin/volume/delete", self.h_volume_delete)
+        app.router.add_post("/admin/volume/readonly", self.h_readonly)
+        app.router.add_post("/admin/ec/generate", self.h_ec_generate)
+        app.router.add_post("/admin/ec/rebuild", self.h_ec_rebuild)
+        app.router.add_post("/admin/ec/mount", self.h_ec_mount)
+        app.router.add_post("/admin/ec/unmount", self.h_ec_unmount)
+        app.router.add_post("/admin/ec/copy", self.h_ec_copy)
+        app.router.add_post("/admin/ec/delete_shards", self.h_ec_delete_shards)
+        app.router.add_get("/admin/ec/shard_read", self.h_ec_shard_read)
+        app.router.add_get("/admin/file", self.h_admin_file)
+        app.router.add_get("/status", self.h_status)
+        # public needle API — catch-all LAST
+        app.router.add_route("GET", "/{fid:[^/]+}", self.h_get)
+        app.router.add_route("HEAD", "/{fid:[^/]+}", self.h_get)
+        app.router.add_route("POST", "/{fid:[^/]+}", self.h_post)
+        app.router.add_route("PUT", "/{fid:[^/]+}", self.h_post)
+        app.router.add_route("DELETE", "/{fid:[^/]+}", self.h_delete)
+        return app
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    async def start(self) -> None:
+        self._http = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=60))
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.ip, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = site._server.sockets[0].getsockname()[1]
+        self.store.ip = self.ip
+        self.store.port = self.port
+        if not self.store.public_url or self.store.public_url.endswith(":0"):
+            self.store.public_url = self.url
+        # remote EC shard reads run inside executor threads, so they use a
+        # synchronous client (readRemoteEcShardInterval, store_ec.go:211+)
+        self.store.fetch_remote_shard = self._sync_fetch_remote_shard
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+
+    async def stop(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        if self._http:
+            await self._http.close()
+        if self._runner:
+            await self._runner.cleanup()
+        self.store.close()
+
+    def _sync_fetch_remote_shard(self, vid: int, shard_id: int,
+                                 offset: int, size: int) -> bytes | None:
+        """Blocking remote shard interval fetch via the master's EC
+        location registry; called from executor threads only."""
+        import json as _json
+        import urllib.request
+        try:
+            with urllib.request.urlopen(
+                    f"http://{self.master_url}/vol/ec_lookup?volumeId={vid}",
+                    timeout=10) as r:
+                shards = _json.load(r)["shards"]
+        except Exception:
+            return None
+        for target in shards.get(str(shard_id), []):
+            if target == self.url:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://{target}/admin/ec/shard_read?volume={vid}"
+                        f"&shard={shard_id}&offset={offset}&size={size}",
+                        timeout=30) as r:
+                    data = r.read()
+                    if len(data) == size:
+                        return data
+            except Exception:
+                continue
+        return None
+
+    # ---- heartbeat loop ----
+
+    async def heartbeat_once(self) -> None:
+        hb = self.store.collect_heartbeat(self.data_center, self.rack)
+        try:
+            async with self._http.post(
+                    f"http://{self.master_url}/cluster/heartbeat",
+                    json=hb.to_dict()) as resp:
+                body = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            # re-queue the consumed deltas so they reach the master when
+            # connectivity returns
+            self.store.new_volumes.extend(hb.new_volumes)
+            self.store.deleted_volumes.extend(hb.deleted_volumes)
+            self.store.new_ec_shards.extend(hb.new_ec_shards)
+            self.store.deleted_ec_shards.extend(hb.deleted_ec_shards)
+            raise
+        self.volume_size_limit = body.get(
+            "volume_size_limit", self.volume_size_limit)
+        leader = body.get("leader")
+        if leader and leader != self.master_url:
+            self.master_url = leader
+
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            try:
+                await self.heartbeat_once()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                pass
+            await asyncio.sleep(self.pulse_seconds)
+
+    # ---- public needle handlers ----
+
+    @staticmethod
+    def _parse_fid(fid: str) -> t.FileId:
+        return t.FileId.parse(fid)
+
+    async def h_get(self, req: web.Request) -> web.Response:
+        try:
+            fid = self._parse_fid(req.match_info["fid"])
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if not self.store.has_volume(fid.volume_id):
+            if not self.read_redirect:
+                return web.json_response({"error": "not found"}, status=404)
+            # misrouted read: redirect via master lookup (handlers_read.go:46)
+            async with self._http.get(
+                    f"http://{self.master_url}/dir/lookup",
+                    params={"volumeId": str(fid.volume_id)}) as resp:
+                if resp.status != 200:
+                    return web.json_response({"error": "volume not found"},
+                                             status=404)
+                locs = (await resp.json())["locations"]
+            others = [l for l in locs if l["url"] != self.url]
+            if not others:
+                return web.json_response({"error": "volume not found"},
+                                         status=404)
+            raise web.HTTPMovedPermanently(
+                f"http://{others[0]['publicUrl']}/{req.match_info['fid']}")
+        try:
+            # disk (and possibly remote-shard) I/O: keep off the event loop
+            loop = asyncio.get_running_loop()
+            n = await loop.run_in_executor(
+                None, lambda: self.store.read_needle(
+                    fid.volume_id, fid.key, fid.cookie))
+        except (NotFound, AlreadyDeleted):
+            return web.Response(status=404)
+        except CrcMismatch as e:
+            return web.json_response({"error": str(e)}, status=500)
+        headers = {"Etag": f'"{n.etag()}"'}
+        body = n.data
+        if n.is_gzipped:
+            if "gzip" in req.headers.get("Accept-Encoding", ""):
+                headers["Content-Encoding"] = "gzip"
+            else:
+                body = gzip.decompress(body)
+        if n.last_modified:
+            headers["Last-Modified"] = time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
+        ct = n.mime.decode() if n.mime else "application/octet-stream"
+        if req.method == "HEAD":
+            return web.Response(status=200, headers=headers, content_type=ct)
+        return web.Response(body=body, headers=headers, content_type=ct)
+
+    async def _needle_from_request(self, req: web.Request,
+                                   fid: t.FileId) -> Needle:
+        """ParseUpload analog (needle.go:54): multipart or raw body."""
+        name = b""
+        mime = b""
+        data = b""
+        ctype = req.headers.get("Content-Type", "")
+        if ctype.startswith("multipart/form-data"):
+            reader = await req.multipart()
+            async for part in reader:
+                if part.name in ("file", "upload", None) or part.filename:
+                    data = await part.read(decode=False)
+                    if part.filename:
+                        name = part.filename.encode()
+                    pct = part.headers.get("Content-Type", "")
+                    if pct and pct != "application/octet-stream":
+                        mime = pct.encode()
+                    break
+        else:
+            data = await req.read()
+            if ctype and ctype != "application/octet-stream":
+                mime = ctype.split(";")[0].encode()
+        n = Needle(cookie=fid.cookie, id=fid.key, data=data, name=name,
+                   mime=mime, ttl=t.TTL.parse(req.query.get("ttl", "")),
+                   last_modified=int(time.time()))
+        n.set_flag(FLAG_HAS_LAST_MODIFIED)
+        return n
+
+    async def h_post(self, req: web.Request) -> web.Response:
+        try:
+            fid = self._parse_fid(req.match_info["fid"])
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if req.headers.get("X-Raw-Needle") == "1":
+            # replica write: body is the serialized needle record
+            n = Needle.from_bytes(await req.read(), t.CURRENT_VERSION)
+        else:
+            n = await self._needle_from_request(req, fid)
+        try:
+            loop = asyncio.get_running_loop()
+            _, size = await loop.run_in_executor(
+                None, lambda: self.store.write_needle(fid.volume_id, n))
+        except NotFound:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        except VolumeError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        # replicate unless this IS a replica write (store_replicate.go:21)
+        if req.query.get("type") != "replicate":
+            v = self.store.volumes.get(fid.volume_id)
+            rp = v.super_block.replica_placement if v else None
+            if rp and rp.copy_count > 1:
+                ok = await self._replicate(req.match_info["fid"],
+                                           "POST", n.to_bytes(3))
+                if not ok:
+                    return web.json_response(
+                        {"error": "replication failed"}, status=500)
+        return web.json_response(
+            {"name": n.name.decode(errors="replace"), "size": size,
+             "eTag": n.etag()}, status=201)
+
+    async def h_delete(self, req: web.Request) -> web.Response:
+        try:
+            fid = self._parse_fid(req.match_info["fid"])
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        n = Needle(cookie=fid.cookie, id=fid.key)
+        is_ec = fid.volume_id in self.store.ec_volumes
+        try:
+            loop = asyncio.get_running_loop()
+            size = await loop.run_in_executor(
+                None, lambda: self.store.delete_needle(fid.volume_id, n))
+        except NotFound:
+            return web.json_response({"error": "volume not found"},
+                                     status=404)
+        if req.query.get("type") != "replicate":
+            if is_ec:
+                # tombstone every shard holder's .ecx
+                # (DeleteEcShardNeedle broadcast, store_ec_delete.go:15-101)
+                await self._ec_delete_broadcast(fid.volume_id,
+                                                req.match_info["fid"])
+            else:
+                v = self.store.volumes.get(fid.volume_id)
+                rp = v.super_block.replica_placement if v else None
+                if rp and rp.copy_count > 1:
+                    await self._replicate(req.match_info["fid"],
+                                          "DELETE", None)
+        return web.json_response({"size": size})
+
+    async def _ec_delete_broadcast(self, vid: int, fid: str) -> None:
+        try:
+            async with self._http.get(
+                    f"http://{self.master_url}/vol/ec_lookup",
+                    params={"volumeId": str(vid)}) as resp:
+                if resp.status != 200:
+                    return
+                shards = (await resp.json())["shards"]
+        except aiohttp.ClientError:
+            return
+        targets = {u for urls in shards.values() for u in urls} - {self.url}
+
+        async def one(target: str) -> None:
+            try:
+                async with self._http.delete(
+                        f"http://{target}/{fid}",
+                        params={"type": "replicate"}) as r:
+                    await r.read()
+            except aiohttp.ClientError:
+                pass
+
+        await asyncio.gather(*(one(u) for u in targets))
+
+    async def _replicate(self, fid: str, method: str,
+                         raw_needle: bytes | None) -> bool:
+        """Fan out to the other replica locations
+        (distributedOperation, store_replicate.go:140-155)."""
+        vid = fid.split(",")[0]
+        try:
+            async with self._http.get(
+                    f"http://{self.master_url}/dir/lookup",
+                    params={"volumeId": vid}) as resp:
+                if resp.status != 200:
+                    return False
+                locs = (await resp.json())["locations"]
+        except aiohttp.ClientError:
+            return False
+        targets = [l["url"] for l in locs if l["url"] != self.url]
+
+        async def one(target: str) -> bool:
+            try:
+                if method == "POST":
+                    async with self._http.post(
+                            f"http://{target}/{fid}",
+                            params={"type": "replicate"},
+                            data=raw_needle,
+                            headers={"X-Raw-Needle": "1"}) as r:
+                        return r.status in (200, 201)
+                async with self._http.delete(
+                        f"http://{target}/{fid}",
+                        params={"type": "replicate"}) as r:
+                    return r.status == 200
+            except aiohttp.ClientError:
+                return False
+
+        results = await asyncio.gather(*(one(x) for x in targets))
+        return all(results)
+
+    # ---- admin handlers ----
+
+    async def h_status(self, req: web.Request) -> web.Response:
+        vols = [self.store._volume_message(v).to_dict()
+                for v in self.store.volumes.values()]
+        return web.json_response({
+            "version": "seaweedfs_tpu 0.1", "volumes": vols,
+            "ecVolumes": {vid: sorted(ev.shards)
+                          for vid, ev in self.store.ec_volumes.items()},
+        })
+
+    async def h_allocate(self, req: web.Request) -> web.Response:
+        q = req.query
+        try:
+            self.store.add_volume(
+                int(q["volume"]), q.get("collection", ""),
+                q.get("replication", ""), q.get("ttl", ""),
+                int(q.get("preallocate", 0) or 0))
+        except VolumeError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"ok": True})
+
+    async def h_volume_delete(self, req: web.Request) -> web.Response:
+        self.store.delete_volume(int(req.query["volume"]))
+        return web.json_response({"ok": True})
+
+    async def h_readonly(self, req: web.Request) -> web.Response:
+        self.store.mark_readonly(int(req.query["volume"]))
+        return web.json_response({"ok": True})
+
+    def _base_name(self, vid: int, collection: str) -> str | None:
+        for d in self.store.dirs:
+            base = os.path.join(
+                d, f"{collection}_{vid}" if collection else str(vid))
+            if os.path.exists(base + ".dat") or os.path.exists(base + ".ecx") \
+                    or any(os.path.exists(base + ecpl.to_ext(i))
+                           for i in range(gf.TOTAL_SHARDS)):
+                return base
+        return None
+
+    async def h_ec_generate(self, req: web.Request) -> web.Response:
+        """VolumeEcShardsGenerate (volume_grpc_erasure_coding.go:39-67):
+        .dat -> 14 shards + .ecx, via the TPU encoder."""
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        v = self.store.volumes.get(vid)
+        base = v.file_name() if v else self._base_name(vid, collection)
+        if base is None:
+            return web.json_response({"error": f"volume {vid} not found"},
+                                     status=404)
+        loop = asyncio.get_running_loop()
+
+        def work():
+            ecpl.write_ec_files(base,
+                                large_block=self.store.ec_large_block,
+                                small_block=self.store.ec_small_block)
+            ecpl.write_sorted_file_from_idx(base)
+        await loop.run_in_executor(None, work)
+        return web.json_response({"ok": True})
+
+    async def h_ec_rebuild(self, req: web.Request) -> web.Response:
+        """VolumeEcShardsRebuild (volume_grpc_erasure_coding.go:70-97)."""
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        base = self._base_name(vid, collection)
+        if base is None:
+            return web.json_response({"error": f"ec volume {vid} not found"},
+                                     status=404)
+        loop = asyncio.get_running_loop()
+        try:
+            rebuilt = await loop.run_in_executor(
+                None, lambda: ecpl.rebuild_ec_files(base))
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"rebuilt": rebuilt})
+
+    async def h_ec_mount(self, req: web.Request) -> web.Response:
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        try:
+            shards = self.store.mount_ec_shards(collection, vid)
+        except VolumeError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.json_response({"shards": shards})
+
+    async def h_ec_unmount(self, req: web.Request) -> web.Response:
+        vid = int(req.query["volume"])
+        ids = req.query.get("shards", "")
+        shard_ids = [int(x) for x in ids.split(",") if x] if ids else None
+        self.store.unmount_ec_shards(vid, shard_ids)
+        return web.json_response({"ok": True})
+
+    async def h_ec_copy(self, req: web.Request) -> web.Response:
+        """VolumeEcShardsCopy (volume_grpc_erasure_coding.go:100-148):
+        pull shard files (and optionally .ecx/.ecj) from a source server."""
+        q = req.query
+        vid = int(q["volume"])
+        collection = q.get("collection", "")
+        source = q["source"]
+        shard_ids = [int(x) for x in q.get("shards", "").split(",") if x]
+        copy_ecx = q.get("copy_ecx", "") == "1"
+        d = self.store.dirs[0]
+        base = os.path.join(
+            d, f"{collection}_{vid}" if collection else str(vid))
+        exts = [ecpl.to_ext(sid) for sid in shard_ids]
+        if copy_ecx:
+            exts += [".ecx", ".ecj"]
+        for ext in exts:
+            try:
+                async with self._http.get(
+                        f"http://{source}/admin/file",
+                        params={"volume": str(vid),
+                                "collection": collection,
+                                "ext": ext}) as resp:
+                    if resp.status != 200:
+                        if ext == ".ecj":  # journal may not exist yet
+                            continue
+                        return web.json_response(
+                            {"error": f"fetch {ext} from {source}: "
+                                      f"{resp.status}"}, status=502)
+                    with open(base + ext, "wb") as f:
+                        async for chunk in resp.content.iter_chunked(1 << 20):
+                            f.write(chunk)
+            except aiohttp.ClientError as e:
+                return web.json_response({"error": str(e)}, status=502)
+        return web.json_response({"ok": True})
+
+    async def h_ec_delete_shards(self, req: web.Request) -> web.Response:
+        q = req.query
+        vid = int(q["volume"])
+        collection = q.get("collection", "")
+        shard_ids = [int(x) for x in q.get("shards", "").split(",") if x]
+        base = self._base_name(vid, collection)
+        if base:
+            for sid in shard_ids:
+                p = base + ecpl.to_ext(sid)
+                if os.path.exists(p):
+                    os.remove(p)
+        return web.json_response({"ok": True})
+
+    async def h_ec_shard_read(self, req: web.Request) -> web.Response:
+        """VolumeEcShardRead (volume_grpc_erasure_coding.go:254-320)."""
+        q = req.query
+        vid = int(q["volume"])
+        loop = asyncio.get_running_loop()
+        data = await loop.run_in_executor(
+            None, lambda: self.store.read_ec_shard_interval(
+                vid, int(q["shard"]), int(q["offset"]), int(q["size"])))
+        if data is None:
+            return web.json_response({"error": "shard not found"},
+                                     status=404)
+        return web.Response(body=data,
+                            content_type="application/octet-stream")
+
+    async def h_admin_file(self, req: web.Request) -> web.Response:
+        """Stream a raw volume/shard file (CopyFile analog for ec.copy)."""
+        q = req.query
+        vid = int(q["volume"])
+        collection = q.get("collection", "")
+        ext = q["ext"]
+        allowed = {".dat", ".idx", ".ecx", ".ecj"} | {
+            ecpl.to_ext(i) for i in range(gf.TOTAL_SHARDS)}
+        if ext not in allowed:
+            return web.json_response({"error": "bad ext"}, status=400)
+        base = self._base_name(vid, collection)
+        path = (base + ext) if base else None
+        if not path or not os.path.exists(path):
+            return web.json_response({"error": "file not found"}, status=404)
+        return web.FileResponse(path)

@@ -1,0 +1,270 @@
+"""Cluster-wide EC workflows: ec.encode / ec.rebuild / ec.balance.
+
+Reference: weed/shell/command_ec_encode.go (pick quiet+full volumes, mark
+readonly, generate shards on the source, spread 14 shards round-robin by
+free slots, delete the original volume), command_ec_rebuild.go (find
+deficient EC volumes, gather inputs on the freest node, regenerate, mount),
+command_ec_balance.go:29-100 (dedup -> spread across racks -> within racks;
+the help text is the spec), command_ec_common.go (node collection/moves).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ..ec import gf
+from ..pb import messages as pb
+from .env import CommandEnv
+
+
+async def collect_ec_nodes(env: CommandEnv) -> list[dict]:
+    """EC-capable nodes sorted by free slots desc (collectEcNodes,
+    command_ec_common.go:181)."""
+    nodes = await env.list_nodes()
+    nodes.sort(key=lambda n: -n["freeSlots"])
+    return nodes
+
+
+async def collect_volume_ids_for_ec_encode(
+        env: CommandEnv, collection: str = "",
+        quiet_seconds: float = 3600.0,
+        fullness: float = 0.95,
+        volume_size_limit: int | None = None) -> list[int]:
+    """Quiet + almost-full volume selection (command_ec_encode.go:258-290).
+
+    Without per-volume mtime on the wire we use size >= fullness * limit;
+    quiet_seconds=0 disables the quiet filter (used by tests/admin force).
+    """
+    if volume_size_limit is None:
+        status = await env.master_get("/cluster/status")
+        volume_size_limit = status.get("volume_size_limit", 0) or 0
+    vids = []
+    for node in await env.list_nodes():
+        for m in node["volumes"]:
+            if collection and m["collection"] != collection:
+                continue
+            if volume_size_limit and \
+                    m["size"] < fullness * volume_size_limit:
+                continue
+            vids.append(m["id"])
+    return sorted(set(vids))
+
+
+async def ec_encode_volume(env: CommandEnv, vid: int,
+                           collection: str = "") -> dict:
+    """doEcEncode for one volume (command_ec_encode.go:89-117)."""
+    # locate replicas
+    lookup = await env.master_get("/dir/lookup", volumeId=str(vid))
+    if "locations" not in lookup:
+        raise RuntimeError(f"volume {vid} not found")
+    locations = [l["url"] for l in lookup["locations"]]
+    source = locations[0]
+
+    # 1. mark readonly everywhere (:119)
+    for url in locations:
+        await env.node_post(url, "/admin/volume/readonly", volume=str(vid))
+
+    # 2. generate 14 shards + .ecx on the source (:139)
+    await env.node_post(source, "/admin/ec/generate", volume=str(vid),
+                        collection=collection)
+
+    # 3. spread shards across servers round-robin by free slots (:153-256)
+    nodes = await collect_ec_nodes(env)
+    assignments = balanced_ec_distribution(nodes, source)
+    copies = []
+    for target, shard_ids in assignments.items():
+        if target == source or not shard_ids:
+            continue
+        copies.append(env.node_post(
+            target, "/admin/ec/copy", volume=str(vid),
+            collection=collection, source=source,
+            shards=",".join(map(str, shard_ids)), copy_ecx="1"))
+    await asyncio.gather(*copies)
+
+    # 4. on every holder (copies are complete): drop the shard files not
+    # assigned to it, then mount what remains (:177)
+    for target, shard_ids in assignments.items():
+        if not shard_ids:
+            continue
+        extras = [s for s in range(gf.TOTAL_SHARDS) if s not in shard_ids]
+        if extras:
+            await env.node_post(target, "/admin/ec/delete_shards",
+                                volume=str(vid), collection=collection,
+                                shards=",".join(map(str, extras)))
+        await env.node_post(target, "/admin/ec/mount", volume=str(vid),
+                            collection=collection)
+
+    # 5. delete the original volume on all replicas (:177-195)
+    for url in locations:
+        await env.node_post(url, "/admin/volume/delete", volume=str(vid))
+    return {"volume": vid, "assignments": assignments}
+
+
+def balanced_ec_distribution(nodes: list[dict],
+                             source: str) -> dict[str, list[int]]:
+    """Round-robin the 14 shards over servers by free slots
+    (balancedEcDistribution, command_ec_encode.go:240-256)."""
+    if not nodes:
+        return {source: list(range(gf.TOTAL_SHARDS))}
+    alloc: dict[str, list[int]] = {n["url"]: [] for n in nodes}
+    free = {n["url"]: max(n["freeSlots"], 0) for n in nodes}
+    urls = list(alloc)
+    i = 0
+    for sid in range(gf.TOTAL_SHARDS):
+        # next node with capacity, preferring emptier ones round-robin
+        for _ in range(len(urls)):
+            url = urls[i % len(urls)]
+            i += 1
+            if free[url] > 0 or all(f <= 0 for f in free.values()):
+                alloc[url].append(sid)
+                free[url] -= 1
+                break
+    return {u: s for u, s in alloc.items() if s}
+
+
+async def ec_encode(env: CommandEnv, collection: str = "",
+                    vids: list[int] | None = None,
+                    fullness: float = 0.95) -> list[dict]:
+    """ec.encode command entry (command_ec_encode.go:55)."""
+    if vids is None:
+        vids = await collect_volume_ids_for_ec_encode(
+            env, collection, fullness=fullness)
+    results = []
+    for vid in vids:
+        results.append(await ec_encode_volume(env, vid, collection))
+    return results
+
+
+# ---------------------------------------------------------------------------
+# ec.rebuild (command_ec_rebuild.go)
+# ---------------------------------------------------------------------------
+
+
+async def ec_shard_map(env: CommandEnv) -> dict[int, dict]:
+    """vid -> {collection, shards: {sid: [urls]}} from node ec registries."""
+    out: dict[int, dict] = {}
+    for node in await env.list_nodes():
+        for m in node["ecShards"]:
+            e = out.setdefault(m["id"], {"collection": m["collection"],
+                                         "shards": {}})
+            for sid in pb.shard_bits_list(m["ec_index_bits"]):
+                e["shards"].setdefault(sid, []).append(node["url"])
+    return out
+
+
+async def ec_rebuild(env: CommandEnv, collection: str = "",
+                     apply_changes: bool = True) -> list[dict]:
+    """Rebuild every deficient EC volume (10 <= shards < 14); <10 shards is
+    unrepairable (command_ec_rebuild.go:93-243)."""
+    results = []
+    shard_map = await ec_shard_map(env)
+    nodes = await collect_ec_nodes(env)
+    for vid, info in sorted(shard_map.items()):
+        if collection and info["collection"] != collection:
+            continue
+        have = sorted(info["shards"])
+        if len(have) == gf.TOTAL_SHARDS:
+            continue
+        if len(have) < gf.DATA_SHARDS:
+            results.append({"volume": vid, "error":
+                            f"unrepairable: only {len(have)} shards"})
+            continue
+        if not apply_changes:
+            results.append({"volume": vid, "missing":
+                            [s for s in range(gf.TOTAL_SHARDS)
+                             if s not in have]})
+            continue
+        rebuilder = nodes[0]["url"]
+        # gather >=10 input shards onto the rebuilder (prepareDataToRecover)
+        copied = []
+        for sid in have:
+            holders = info["shards"][sid]
+            if rebuilder in holders:
+                continue
+            await env.node_post(rebuilder, "/admin/ec/copy",
+                                volume=str(vid),
+                                collection=info["collection"],
+                                source=holders[0],
+                                shards=str(sid), copy_ecx="1")
+            copied.append(sid)
+        # regenerate missing (VolumeEcShardsRebuild)
+        resp = await env.node_post(rebuilder, "/admin/ec/rebuild",
+                                   volume=str(vid),
+                                   collection=info["collection"])
+        rebuilt = resp.get("rebuilt", [])
+        # drop the borrowed input shards, keep the rebuilt ones
+        if copied:
+            await env.node_post(rebuilder, "/admin/ec/delete_shards",
+                                volume=str(vid),
+                                collection=info["collection"],
+                                shards=",".join(map(str, copied)))
+        await env.node_post(rebuilder, "/admin/ec/mount", volume=str(vid),
+                            collection=info["collection"])
+        results.append({"volume": vid, "rebuilt": rebuilt,
+                        "node": rebuilder})
+    return results
+
+
+# ---------------------------------------------------------------------------
+# ec.balance (command_ec_balance.go)
+# ---------------------------------------------------------------------------
+
+
+async def ec_balance(env: CommandEnv, collection: str = "",
+                     apply_changes: bool = True) -> list[dict]:
+    """Spread shards: no duplicate shard copies on one node, then even
+    counts per node (dedup + spread steps of command_ec_balance.go:29-100).
+    """
+    moves = []
+    shard_map = await ec_shard_map(env)
+    nodes = await collect_ec_nodes(env)
+    if not nodes:
+        return moves
+    url_free = {n["url"]: n["freeSlots"] for n in nodes}
+    for vid, info in sorted(shard_map.items()):
+        if collection and info["collection"] != collection:
+            continue
+        # count shards per node for this volume
+        per_node: dict[str, list[int]] = {}
+        for sid, holders in info["shards"].items():
+            for url in holders:
+                per_node.setdefault(url, []).append(sid)
+        total = sum(len(s) for s in per_node.values())
+        fair = -(-total // max(len(nodes), 1))
+        over = {u: sorted(s) for u, s in per_node.items() if len(s) > fair}
+        for src, sids in over.items():
+            excess = sids[fair:]
+            for sid in excess:
+                # move to the node with the fewest shards of this volume
+                candidates = sorted(
+                    (u for u in url_free if u != src),
+                    key=lambda u: (len(per_node.get(u, [])),
+                                   -url_free.get(u, 0)))
+                dst = next((u for u in candidates
+                            if sid not in per_node.get(u, [])), None)
+                if dst is None:
+                    continue
+                moves.append({"volume": vid, "shard": sid,
+                              "from": src, "to": dst})
+                if apply_changes:
+                    await move_ec_shard(env, vid, info["collection"],
+                                        sid, src, dst)
+                per_node.setdefault(dst, []).append(sid)
+                per_node[src].remove(sid)
+    return moves
+
+
+async def move_ec_shard(env: CommandEnv, vid: int, collection: str,
+                        sid: int, src: str, dst: str) -> None:
+    """moveMountedShardToEcNode (command_ec_common.go:18-75): copy to dst,
+    mount there, unmount + delete on src."""
+    await env.node_post(dst, "/admin/ec/copy", volume=str(vid),
+                        collection=collection, source=src,
+                        shards=str(sid), copy_ecx="1")
+    await env.node_post(dst, "/admin/ec/mount", volume=str(vid),
+                        collection=collection)
+    await env.node_post(src, "/admin/ec/unmount", volume=str(vid),
+                        shards=str(sid))
+    await env.node_post(src, "/admin/ec/delete_shards", volume=str(vid),
+                        collection=collection, shards=str(sid))

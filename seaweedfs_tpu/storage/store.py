@@ -1,0 +1,263 @@
+"""Store: the per-volume-server manager of volumes and EC shards.
+
+Reference: weed/storage/store.go:24-40 (DiskLocations, read/write/delete
+routing, heartbeat building), disk_location.go (load volumes on start),
+disk_location_ec.go (discover EC shards), store_ec.go (EC reads).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import threading
+
+from ..ec import gf
+from ..ec.ec_volume import EcVolume, NotFoundError as EcNotFound
+from ..ec.locate import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE
+from ..pb import messages as pb
+from . import types as t
+from .needle import Needle
+from .super_block import ReplicaPlacement
+from .volume import AlreadyDeleted, NotFound, Volume, VolumeError
+
+
+_VOL_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.dat$")
+_EC_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ecx$")
+
+
+class Store:
+    def __init__(self, dirs: list[str], ip: str = "localhost",
+                 port: int = 0, public_url: str = "",
+                 max_volume_counts: list[int] | None = None,
+                 ec_large_block: int = LARGE_BLOCK_SIZE,
+                 ec_small_block: int = SMALL_BLOCK_SIZE):
+        self.dirs = dirs
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.max_volume_counts = max_volume_counts or [8] * len(dirs)
+        self.ec_large_block = ec_large_block
+        self.ec_small_block = ec_small_block
+        self.volumes: dict[int, Volume] = {}
+        self.ec_volumes: dict[int, EcVolume] = {}
+        self._lock = threading.RLock()
+        # deltas queued for the next heartbeat
+        self.new_volumes: list[pb.VolumeInformationMessage] = []
+        self.deleted_volumes: list[pb.VolumeInformationMessage] = []
+        self.new_ec_shards: list[pb.VolumeEcShardInformationMessage] = []
+        self.deleted_ec_shards: list[pb.VolumeEcShardInformationMessage] = []
+        # remote shard reader injected by the volume server layer
+        self.fetch_remote_shard = None
+        for d in dirs:
+            os.makedirs(d, exist_ok=True)
+            self._load_existing(d)
+
+    # ---- loading (disk_location.go:79-113, disk_location_ec.go:115-161) ----
+
+    def _load_existing(self, d: str) -> None:
+        for path in glob.glob(os.path.join(d, "*.dat")):
+            m = _VOL_RE.match(os.path.basename(path))
+            if not m:
+                continue
+            vid = int(m.group("vid"))
+            col = m.group("col") or ""
+            try:
+                self.volumes[vid] = Volume(d, col, vid,
+                                           create_if_missing=False)
+            except VolumeError:
+                continue
+        for path in glob.glob(os.path.join(d, "*.ecx")):
+            m = _EC_RE.match(os.path.basename(path))
+            if not m:
+                continue
+            vid = int(m.group("vid"))
+            if vid in self.volumes:
+                continue
+            col = m.group("col") or ""
+            try:
+                self._mount_ec(d, col, vid)
+            except OSError:
+                continue
+
+    def _mount_ec(self, d: str, collection: str, vid: int) -> EcVolume:
+        ev = EcVolume(d, collection, vid,
+                      large_block=self.ec_large_block,
+                      small_block=self.ec_small_block,
+                      fetch_remote=self._make_remote_fetcher(vid))
+        self.ec_volumes[vid] = ev
+        return ev
+
+    def _make_remote_fetcher(self, vid: int):
+        def fetch(shard_id: int, offset: int, size: int):
+            if self.fetch_remote_shard is None:
+                return None
+            return self.fetch_remote_shard(vid, shard_id, offset, size)
+        return fetch
+
+    # ---- volume lifecycle ----
+
+    def add_volume(self, vid: int, collection: str = "",
+                   replication: str = "", ttl: str = "",
+                   preallocate: int = 0) -> Volume:
+        with self._lock:
+            if vid in self.volumes:
+                raise VolumeError(f"volume {vid} already exists")
+            v = Volume(self.dirs[vid % len(self.dirs)], collection, vid,
+                       replica_placement=ReplicaPlacement.parse(replication),
+                       ttl=t.TTL.parse(ttl), preallocate=preallocate)
+            self.volumes[vid] = v
+            self.new_volumes.append(self._volume_message(v))
+            return v
+
+    def delete_volume(self, vid: int) -> None:
+        with self._lock:
+            v = self.volumes.pop(vid, None)
+            if v is not None:
+                msg = self._volume_message(v)
+                v.destroy()
+                self.deleted_volumes.append(msg)
+
+    def mark_readonly(self, vid: int) -> None:
+        with self._lock:
+            if vid in self.volumes:
+                self.volumes[vid].read_only = True
+
+    # ---- data plane ----
+
+    def write_needle(self, vid: int, n: Needle) -> tuple[int, int]:
+        v = self.volumes.get(vid)
+        if v is None:
+            raise NotFound(f"volume {vid} not found")
+        return v.write_needle(n)
+
+    def read_needle(self, vid: int, needle_id: int,
+                    cookie: int | None = None) -> Needle:
+        v = self.volumes.get(vid)
+        if v is not None:
+            return v.read_needle(needle_id, cookie)
+        ev = self.ec_volumes.get(vid)
+        if ev is not None:
+            try:
+                return ev.read_needle(needle_id, cookie)
+            except EcNotFound as e:
+                raise NotFound(str(e))
+        raise NotFound(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, n: Needle) -> int:
+        v = self.volumes.get(vid)
+        if v is not None:
+            return v.delete_needle(n)
+        ev = self.ec_volumes.get(vid)
+        if ev is not None:
+            ev.delete_needle(n.id)
+            return 0
+        raise NotFound(f"volume {vid} not found")
+
+    def has_volume(self, vid: int) -> bool:
+        return vid in self.volumes or vid in self.ec_volumes
+
+    # ---- EC shard lifecycle (server side of ec.encode/rebuild) ----
+
+    def mount_ec_shards(self, collection: str, vid: int) -> list[int]:
+        with self._lock:
+            ev = self.ec_volumes.get(vid)
+            if ev is not None:
+                ev.close()
+            for d in self.dirs:
+                base = os.path.join(
+                    d, f"{collection}_{vid}" if collection else str(vid))
+                if os.path.exists(base + ".ecx"):
+                    ev = self._mount_ec(d, collection, vid)
+                    bits = 0
+                    for sid in ev.shards:
+                        bits = pb.shard_bits_add(bits, sid)
+                    self.new_ec_shards.append(
+                        pb.VolumeEcShardInformationMessage(
+                            id=vid, collection=collection,
+                            ec_index_bits=bits))
+                    return sorted(ev.shards)
+            raise VolumeError(f"no .ecx found for ec volume {vid}")
+
+    def unmount_ec_shards(self, vid: int, shard_ids: list[int] | None = None
+                          ) -> None:
+        with self._lock:
+            ev = self.ec_volumes.get(vid)
+            if ev is None:
+                return
+            bits = 0
+            removed = shard_ids if shard_ids is not None else list(ev.shards)
+            for sid in removed:
+                f = ev.shards.pop(sid, None)
+                if f is not None:
+                    f.close()
+                bits = pb.shard_bits_add(bits, sid)
+            self.deleted_ec_shards.append(
+                pb.VolumeEcShardInformationMessage(
+                    id=vid, collection=ev.collection, ec_index_bits=bits))
+            if not ev.shards:
+                ev.close()
+                del self.ec_volumes[vid]
+
+    def read_ec_shard_interval(self, vid: int, shard_id: int,
+                               offset: int, size: int) -> bytes | None:
+        ev = self.ec_volumes.get(vid)
+        if ev is None:
+            return None
+        f = ev.shards.get(shard_id)
+        if f is None:
+            return None
+        data = os.pread(f.fileno(), size, offset)
+        return data + b"\x00" * (size - len(data))
+
+    # ---- heartbeat (store.go:165-219 CollectHeartbeat) ----
+
+    def _volume_message(self, v: Volume) -> pb.VolumeInformationMessage:
+        st = v.stat()
+        return pb.VolumeInformationMessage(
+            id=v.vid, size=st.size, collection=v.collection,
+            file_count=st.file_count, delete_count=st.deleted_count,
+            deleted_byte_count=st.deleted_bytes, read_only=v.read_only,
+            replica_placement=v.super_block.replica_placement.to_byte(),
+            version=v.version, ttl=v.ttl.to_uint32(),
+            compact_revision=v.super_block.compaction_revision)
+
+    def collect_heartbeat(self, data_center: str = "",
+                          rack: str = "") -> pb.Heartbeat:
+        with self._lock:
+            volumes = [self._volume_message(v) for v in self.volumes.values()]
+            ec_msgs = []
+            for vid, ev in self.ec_volumes.items():
+                bits = 0
+                for sid in ev.shards:
+                    bits = pb.shard_bits_add(bits, sid)
+                ec_msgs.append(pb.VolumeEcShardInformationMessage(
+                    id=vid, collection=ev.collection, ec_index_bits=bits))
+            max_key = max((v.nm.max_file_key
+                           for v in self.volumes.values()), default=0)
+            hb = pb.Heartbeat(
+                ip=self.ip, port=self.port, public_url=self.public_url,
+                max_volume_count=sum(self.max_volume_counts),
+                max_file_key=max_key,
+                data_center=data_center, rack=rack,
+                volumes=volumes,
+                new_volumes=self.new_volumes[:],
+                deleted_volumes=self.deleted_volumes[:],
+                ec_shards=ec_msgs,
+                has_no_volumes=not volumes,
+                has_no_ec_shards=not ec_msgs,
+            )
+            self.new_volumes.clear()
+            self.deleted_volumes.clear()
+            hb.new_ec_shards = self.new_ec_shards[:]
+            hb.deleted_ec_shards = self.deleted_ec_shards[:]
+            self.new_ec_shards.clear()
+            self.deleted_ec_shards.clear()
+            return hb
+
+    def close(self) -> None:
+        with self._lock:
+            for v in self.volumes.values():
+                v.close()
+            for ev in self.ec_volumes.values():
+                ev.close()

@@ -1,0 +1,118 @@
+"""Volume layouts and rack-aware replica placement.
+
+Reference: weed/topology/volume_layout.go (per-(collection, replication,
+ttl) writable sets), volume_growth.go:106-202 (findEmptySlotsForOneVolume:
+3-level constrained random placement over DC -> rack -> server).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+
+from ..storage.super_block import ReplicaPlacement
+from .tree import DataCenter, DataNode, Rack, Topology
+
+
+class PlacementError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class LayoutKey:
+    collection: str
+    replication: str
+    ttl: str
+
+
+class VolumeLayout:
+    """Tracks writable volume ids for one layout key
+    (volume_layout.go:17-32)."""
+
+    def __init__(self, key: LayoutKey, volume_size_limit: int):
+        self.key = key
+        self.volume_size_limit = volume_size_limit
+        self.writable: set[int] = set()
+
+    def set_writable(self, vid: int, writable: bool) -> None:
+        if writable:
+            self.writable.add(vid)
+        else:
+            self.writable.discard(vid)
+
+    def pick_for_write(self, topo: Topology,
+                       replica_count: int) -> int | None:
+        """Random writable vid that still has enough replicas registered
+        (volume_layout.go:165 PickForWrite)."""
+        candidates = [vid for vid in self.writable
+                      if len(topo.volume_locations.get(vid, {}))
+                      >= replica_count]
+        if not candidates:
+            return None
+        return random.choice(candidates)
+
+
+def find_empty_slots(topo: Topology, rp: ReplicaPlacement,
+                     preferred_dc: str | None = None
+                     ) -> list[DataNode]:
+    """Pick rp.copy_count() servers satisfying the xyz constraints:
+    1 main server; rp.same_rack more on other servers of the same rack;
+    rp.diff_rack more on other racks of the same DC; rp.diff_dc more on
+    other DCs (volume_growth.go:106-202).
+    """
+    dcs = [dc for dc in topo.data_centers.values()
+           if preferred_dc in (None, "", dc.id)]
+    random.shuffle(dcs)
+    last_err = "no data centers with capacity"
+    for dc in dcs:
+        try:
+            return _place_in_dc(topo, dc, rp)
+        except PlacementError as e:
+            last_err = str(e)
+    raise PlacementError(last_err)
+
+
+def _place_in_dc(topo: Topology, main_dc: DataCenter,
+                 rp: ReplicaPlacement) -> list[DataNode]:
+    # main rack must supply 1 + same_rack servers; main DC must supply
+    # 1 + diff_rack racks; cluster must supply 1 + diff_dc DCs.
+    other_dcs = [d for d in topo.data_centers.values()
+                 if d is not main_dc and d.free_space() > 0]
+    if len(other_dcs) < rp.diff_dc:
+        raise PlacementError(
+            f"need {rp.diff_dc} other DCs with capacity, "
+            f"have {len(other_dcs)}")
+
+    racks = [r for r in main_dc.racks.values() if r.free_space() > 0]
+    random.shuffle(racks)
+    for main_rack in racks:
+        other_racks = [r for r in main_dc.racks.values()
+                       if r is not main_rack and r.free_space() > 0]
+        if len(other_racks) < rp.diff_rack:
+            continue
+        nodes = [n for n in main_rack.nodes.values() if n.free_space() > 0]
+        if len(nodes) < 1 + rp.same_rack:
+            continue
+        picked = topo.pick_weighted(nodes, 1 + rp.same_rack)
+        if len(picked) < 1 + rp.same_rack:
+            continue
+        # one server from each of rp.diff_rack other racks
+        for r in topo.pick_weighted(other_racks, rp.diff_rack):
+            n = topo.pick_weighted(list(r.nodes.values()), 1)
+            if not n:
+                raise PlacementError(f"rack {r.id} has no free server")
+            picked += n
+        # one server from each of rp.diff_dc other DCs
+        for d in topo.pick_weighted(other_dcs, rp.diff_dc):
+            all_nodes = [n for r in d.racks.values()
+                         for n in r.nodes.values()]
+            n = topo.pick_weighted(all_nodes, 1)
+            if not n:
+                raise PlacementError(f"dc {d.id} has no free server")
+            picked += n
+        if len(picked) == rp.copy_count:
+            return picked
+    raise PlacementError(
+        f"dc {main_dc.id}: no rack satisfies replication "
+        f"{rp} (need 1+{rp.same_rack} servers in one rack, "
+        f"{rp.diff_rack} other racks)")

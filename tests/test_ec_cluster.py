@@ -1,0 +1,134 @@
+"""Cluster-wide EC workflows: ec.encode -> distributed reads ->
+shard loss -> degraded read over the network -> ec.rebuild -> ec.balance.
+
+This is the reference's north-star flow (SURVEY.md §3.3/3.4) running on the
+in-proc cluster.
+"""
+
+import asyncio
+import os
+import random
+
+from cluster_util import Cluster, run
+
+from seaweedfs_tpu.ec import gf
+from seaweedfs_tpu.shell.env import CommandEnv
+from seaweedfs_tpu.shell import ec_commands as ec
+
+
+async def _fill_volume(c: Cluster, n_files: int = 40) -> list[tuple[str, str, bytes]]:
+    rng = random.Random(5)
+    out = []
+    for i in range(n_files):
+        a = await c.assign(collection="ectest")
+        data = bytes(rng.getrandbits(8)
+                     for _ in range(rng.randint(500, 8000)))
+        st, _ = await c.put(a["fid"], a["url"], data)
+        assert st == 201
+        out.append((a["fid"], a["publicUrl"], data))
+    return out
+
+
+def test_ec_encode_spread_read_rebuild_balance(tmp_path):
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=4) as c:
+            files = await _fill_volume(c)
+            await c.heartbeat_all()
+            async with CommandEnv(c.master.url, c.http) as env:
+                vids = sorted({int(f.split(",")[0]) for f, _, _ in files})
+                res = await ec.ec_encode(env, collection="ectest", vids=vids)
+                assert res, "ec.encode produced no results"
+                # shards spread over all 4 servers
+                assignments = res[0]["assignments"]
+                assert len(assignments) == 4
+                assert sum(len(s) for s in assignments.values()) == 14
+
+            await c.heartbeat_all()
+            # original volumes gone; reads now go through EC paths,
+            # including cross-server remote shard fetch
+            for vs in c.servers:
+                assert not any(int(v.split(",")[0]) in vs.store.volumes
+                               for v, _, _ in files)
+            for fid, url, data in files[:10]:
+                st, got = await c.get(fid, url)
+                assert st == 200 and got == data, fid
+
+            # destroy one server's shard files -> degraded read still works
+            async with CommandEnv(c.master.url, c.http) as env:
+                smap = await ec.ec_shard_map(env)
+            vid = vids[0]
+            victim_url = smap[vid]["shards"][0][0]
+            import seaweedfs_tpu.ec.pipeline as pl
+            victim = next(v for v in c.servers if v.url == victim_url)
+            lost = sorted(victim.store.ec_volumes[vid].shards)
+            base = victim._base_name(vid, "ectest")
+            victim.store.unmount_ec_shards(vid)
+            for sid in lost:
+                os.remove(base + pl.to_ext(sid))
+            await c.heartbeat_all()
+
+            for fid, url, data in files[:5]:
+                server = next(s for s in c.servers if s.url != victim_url)
+                st, got = await c.get(fid, server.url)
+                assert st == 200 and got == data, ("degraded", fid)
+
+            # ec.rebuild regenerates the lost shards somewhere
+            async with CommandEnv(c.master.url, c.http) as env:
+                results = await ec.ec_rebuild(env, collection="ectest")
+            assert any(r.get("rebuilt") for r in results), results
+            await c.heartbeat_all()
+            async with CommandEnv(c.master.url, c.http) as env:
+                smap = await ec.ec_shard_map(env)
+            assert len(smap[vid]["shards"]) == gf.TOTAL_SHARDS
+
+            # ec.balance produces no moves or only valid ones, and reads
+            # still succeed afterwards
+            async with CommandEnv(c.master.url, c.http) as env:
+                moves = await ec.ec_balance(env, collection="ectest")
+            await c.heartbeat_all()
+            for fid, url, data in files[:5]:
+                st, got = await c.get(fid, url)
+                assert st == 200 and got == data, ("post-balance", fid)
+
+            # EC delete broadcasts the tombstone to every shard holder
+            del_fid, del_url, _ = files[0]
+            assert await c.delete(del_fid, del_url) == 200
+            for vs in c.servers:
+                st, _ = await c.get(del_fid, vs.url)
+                assert st == 404, ("ec-delete", vs.url)
+    run(body())
+
+
+def test_ec_rebuild_unrepairable_reported(tmp_path):
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=2) as c:
+            files = await _fill_volume(c, n_files=10)
+            await c.heartbeat_all()
+            vids = sorted({int(f.split(",")[0]) for f, _, _ in files})
+            async with CommandEnv(c.master.url, c.http) as env:
+                await ec.ec_encode(env, collection="ectest", vids=vids)
+            await c.heartbeat_all()
+            vid = vids[0]
+            # destroy shards until < 10 remain
+            import seaweedfs_tpu.ec.pipeline as pl
+            removed = 0
+            for vs in c.servers:
+                ev = vs.store.ec_volumes.get(vid)
+                if ev is None:
+                    continue
+                sids = sorted(ev.shards)
+                for sid in sids:
+                    if removed >= 5:
+                        break
+                    vs.store.unmount_ec_shards(vid, [sid])
+                    p = vs._base_name(vid, "ectest") + pl.to_ext(sid)
+                    if os.path.exists(p):
+                        os.remove(p)
+                    removed += 1
+            assert removed == 5
+            await c.heartbeat_all()
+            async with CommandEnv(c.master.url, c.http) as env:
+                results = await ec.ec_rebuild(env, collection="ectest")
+            assert any("unrepairable" in str(r.get("error", ""))
+                       for r in results), results
+    run(body())
