@@ -1,0 +1,79 @@
+"""FilerStore plugin contract + registry.
+
+Reference: weed/filer2/filerstore.go:13-29 (the 8-store plugin interface)
+and the blank-import registration pattern (server/filer_server.go:23-35).
+Stores register themselves on import; unavailable backends (missing
+drivers) simply don't register.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from .entry import Entry
+
+
+class FilerStore(ABC):
+    name: str = "abstract"
+
+    @abstractmethod
+    def insert_entry(self, entry: Entry) -> None: ...
+
+    @abstractmethod
+    def update_entry(self, entry: Entry) -> None: ...
+
+    @abstractmethod
+    def find_entry(self, path: str) -> Entry | None: ...
+
+    @abstractmethod
+    def delete_entry(self, path: str) -> None: ...
+
+    @abstractmethod
+    def delete_folder_children(self, path: str) -> None: ...
+
+    @abstractmethod
+    def list_directory_entries(self, dir_path: str, start_file: str,
+                               inclusive: bool, limit: int) -> list[Entry]: ...
+
+    def begin_transaction(self):  # optional
+        return None
+
+    def commit_transaction(self):
+        return None
+
+    def rollback_transaction(self):
+        return None
+
+    def close(self) -> None:
+        return None
+
+
+_REGISTRY: dict[str, type[FilerStore]] = {}
+
+
+def register_store(cls: type[FilerStore]) -> type[FilerStore]:
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def available_stores() -> list[str]:
+    _load_builtin()
+    return sorted(_REGISTRY)
+
+
+def create_store(name: str, **kwargs) -> FilerStore:
+    _load_builtin()
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown filer store {name!r}; available: {available_stores()}")
+    return _REGISTRY[name](**kwargs)
+
+
+def _load_builtin() -> None:
+    from .stores import memory_store, sqlite_store  # noqa: F401
+    # optional drivers, reference's mysql/postgres/cassandra/redis/etcd/tikv
+    for mod in ("redis_store", "mysql_store"):
+        try:
+            __import__(f"seaweedfs_tpu.filer.stores.{mod}")
+        except ImportError:
+            pass

@@ -1,0 +1,1 @@
+"""Embedded filer store plugins."""

@@ -1,0 +1,348 @@
+"""Filer server: POSIX-ish HTTP namespace over the volume tier.
+
+Reference: weed/server/filer_server_handlers_read.go:21-260 (streaming +
+range reads over chunks), _write.go + _write_autochunk.go (auto-chunked
+uploads, default 256MB chunks), filer_grpc_server.go (metadata API incl.
+AtomicRenameEntry). The gRPC surface maps to JSON endpoints under /__api__/.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import aiohttp
+from aiohttp import web
+
+from ..filer.entry import Attr, Entry
+from ..filer.filechunks import (FileChunk, etag as chunks_etag, total_size,
+                                view_from_chunks)
+from ..filer.filer import Filer, FilerError
+from ..util.client import OperationError, WeedClient
+from ..util.httprange import RangeError, parse_range
+
+
+class FilerServer:
+    def __init__(self, filer: Filer, master_url: str,
+                 ip: str = "127.0.0.1", port: int = 8888,
+                 chunk_size: int = 32 * 1024 * 1024,
+                 collection: str = "", replication: str = ""):
+        self.filer = filer
+        self.master_url = master_url
+        self.ip = ip
+        self.port = port
+        self.chunk_size = chunk_size
+        self.collection = collection
+        self.replication = replication
+        self._runner: web.AppRunner | None = None
+        self._tasks: list[asyncio.Task] = []
+        self.client: WeedClient | None = None
+        self.app = self._build_app()
+
+    def _build_app(self) -> web.Application:
+        app = web.Application(client_max_size=4 * 1024 * 1024 * 1024)
+        api = [
+            ("POST", "/__api__/rename", self.h_api_rename),
+            ("GET", "/__api__/lookup", self.h_api_lookup),
+            ("GET", "/__api__/list", self.h_api_list),
+            ("POST", "/__api__/entry", self.h_api_create_entry),
+            ("POST", "/__api__/assign", self.h_api_assign),
+            ("POST", "/__api__/delete", self.h_api_delete),
+        ]
+        for method, path, handler in api:
+            app.router.add_route(method, path, handler)
+        app.router.add_route("GET", "/{path:.*}", self.h_get)
+        app.router.add_route("HEAD", "/{path:.*}", self.h_get)
+        app.router.add_route("POST", "/{path:.*}", self.h_post)
+        app.router.add_route("PUT", "/{path:.*}", self.h_post)
+        app.router.add_route("DELETE", "/{path:.*}", self.h_delete)
+        return app
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    async def start(self) -> None:
+        self.client = WeedClient(self.master_url)
+        await self.client.__aenter__()
+        self.filer.chunk_deleter = self._queue_chunk_deletes
+        self._pending: list[str] = []
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.ip, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = site._server.sockets[0].getsockname()[1]
+        self._tasks.append(asyncio.create_task(self._chunk_gc_loop()))
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self.client:
+            await self.client.__aexit__()
+        if self._runner:
+            await self._runner.cleanup()
+        self.filer.close()
+
+    # ---- async chunk GC (filer_deletion.go) ----
+
+    def _queue_chunk_deletes(self, fids: list[str]) -> None:
+        self._pending.extend(fids)
+
+    async def _chunk_gc_loop(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            batch, self._pending = self._pending[:1024], self._pending[1024:]
+            if batch:
+                try:
+                    await self.client.delete_fids(batch)
+                except Exception:
+                    self._pending.extend(batch)
+
+    # ---- normalize ----
+
+    @staticmethod
+    def _path(req: web.Request) -> str:
+        p = "/" + req.match_info["path"]
+        while "//" in p:
+            p = p.replace("//", "/")
+        return p if p == "/" else p.rstrip("/")
+
+    # ---- read path ----
+
+    async def h_get(self, req: web.Request) -> web.StreamResponse:
+        path = self._path(req)
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return web.json_response({"error": "not found"}, status=404)
+        if entry.is_directory:
+            return await self._list_dir(req, path)
+        size = entry.size
+        status = 200
+        offset, length = 0, size
+        try:
+            rng = parse_range(req.headers.get("Range", ""), size)
+        except RangeError:
+            return web.Response(
+                status=416, headers={"Content-Range": f"bytes */{size}"})
+        if rng is not None:
+            offset, length = rng
+            status = 206
+        headers = {
+            "Accept-Ranges": "bytes",
+            "Content-Length": str(length),
+            "Etag": f'"{chunks_etag(entry.chunks)}"',
+            "Last-Modified": time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT",
+                time.gmtime(entry.attr.mtime or 0)),
+        }
+        if status == 206:
+            headers["Content-Range"] = f"bytes {offset}-{offset+length-1}/{size}"
+        ct = entry.attr.mime or "application/octet-stream"
+        if req.method == "HEAD":
+            return web.Response(status=status, headers=headers,
+                                content_type=ct)
+        resp = web.StreamResponse(status=status, headers=headers)
+        resp.content_type = ct
+        await resp.prepare(req)
+        # stream chunk views (filer2/stream.go StreamContent)
+        for view in view_from_chunks(entry.chunks, offset, length):
+            try:
+                data = await self.client.read(view.file_id, view.offset,
+                                              view.size)
+            except OperationError:
+                # headers already sent: abort the connection so the client
+                # sees a transport error, not a silently short body
+                if req.transport is not None:
+                    req.transport.close()
+                return resp
+            await resp.write(data)
+        await resp.write_eof()
+        return resp
+
+    async def _list_dir(self, req: web.Request, path: str) -> web.Response:
+        limit = int(req.query.get("limit", 1000))
+        last = req.query.get("lastFileName", "")
+        entries = self.filer.list_directory_entries(path, last, False, limit)
+        return web.json_response({
+            "Path": path,
+            "Entries": [self._entry_json(e) for e in entries],
+            "ShouldDisplayLoadMore": len(entries) == limit,
+        })
+
+    @staticmethod
+    def _entry_json(e: Entry) -> dict:
+        return {
+            "FullPath": e.full_path,
+            "Mtime": e.attr.mtime, "Crtime": e.attr.crtime,
+            "Mode": e.attr.mode, "Uid": e.attr.uid, "Gid": e.attr.gid,
+            "Mime": e.attr.mime, "Replication": e.attr.replication,
+            "Collection": e.attr.collection, "TtlSec": e.attr.ttl_sec,
+            "IsDirectory": e.is_directory, "FileSize": e.size,
+            "chunks": [c.to_dict() for c in e.chunks],
+            "extended": e.extended,
+        }
+
+    # ---- write path (auto-chunking, _write_autochunk.go:23-188) ----
+
+    async def h_post(self, req: web.Request) -> web.Response:
+        path = self._path(req)
+        if "mv.from" in req.query:
+            try:
+                self.filer.rename_entry(req.query["mv.from"], path)
+            except FilerError as e:
+                return web.json_response({"error": str(e)}, status=400)
+            return web.json_response({"ok": True})
+        raw_path = req.match_info["path"]
+        if (raw_path.endswith("/") and raw_path != "") \
+                or req.query.get("mkdir") == "true":
+            from ..filer.entry import new_directory_entry
+            self.filer.create_entry(new_directory_entry(path))
+            return web.json_response({"name": path}, status=201)
+
+        mime = ""
+        reader = None
+        ctype = req.headers.get("Content-Type", "")
+        filename = ""
+        if ctype.startswith("multipart/form-data"):
+            mp = await req.multipart()
+            async for part in mp:
+                if part.filename or part.name in ("file", None):
+                    filename = part.filename or ""
+                    pct = part.headers.get("Content-Type", "")
+                    if pct and pct != "application/octet-stream":
+                        mime = pct
+                    reader = part
+                    break
+            if reader is None:
+                return web.json_response({"error": "no file part"},
+                                         status=400)
+        else:
+            reader = req.content
+            if ctype and ctype != "application/octet-stream":
+                mime = ctype.split(";")[0]
+
+        collection = req.query.get("collection", self.collection)
+        replication = req.query.get("replication", self.replication)
+        ttl = req.query.get("ttl", "")
+        chunks: list[FileChunk] = []
+        offset = 0
+        try:
+            while True:
+                data = await _read_up_to(reader, self.chunk_size)
+                if not data:
+                    break
+                a = await self.client.assign(
+                    collection=collection, replication=replication, ttl=ttl)
+                up = await self.client.upload(a["fid"], a["url"], data,
+                                              mime=mime, ttl=ttl)
+                chunks.append(FileChunk(
+                    file_id=a["fid"], offset=offset, size=len(data),
+                    mtime=time.time_ns(), etag=up.get("eTag", "")))
+                offset += len(data)
+                if len(data) < self.chunk_size:
+                    break
+        except OperationError as e:
+            # roll back uploaded chunks
+            self.filer.delete_chunks([c.file_id for c in chunks])
+            return web.json_response({"error": str(e)}, status=500)
+
+        now = time.time()
+        entry = Entry(
+            full_path=path,
+            attr=Attr(mtime=now, crtime=now, mode=0o660, mime=mime,
+                      replication=replication, collection=collection,
+                      ttl_sec=0),
+            chunks=chunks)
+        try:
+            self.filer.create_entry(entry)
+        except FilerError as e:
+            self.filer.delete_chunks([c.file_id for c in chunks])
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(
+            {"name": filename or entry.name, "size": offset}, status=201)
+
+    async def h_delete(self, req: web.Request) -> web.Response:
+        path = self._path(req)
+        recursive = req.query.get("recursive") == "true"
+        try:
+            self.filer.delete_entry(path, recursive=recursive,
+                                    ignore_recursive_error=req.query.get(
+                                        "ignoreRecursiveError") == "true")
+        except FilerError as e:
+            code = 404 if "not found" in str(e) else 400
+            return web.json_response({"error": str(e)}, status=code)
+        return web.Response(status=204)
+
+    # ---- metadata API (filer.proto analog) ----
+
+    async def h_api_lookup(self, req: web.Request) -> web.Response:
+        entry = self.filer.find_entry(req.query["path"])
+        if entry is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(self._entry_json(entry))
+
+    async def h_api_list(self, req: web.Request) -> web.Response:
+        entries = self.filer.list_directory_entries(
+            req.query["path"], req.query.get("startFile", ""),
+            req.query.get("inclusive") == "true",
+            int(req.query.get("limit", 1024)))
+        return web.json_response(
+            {"entries": [self._entry_json(e) for e in entries]})
+
+    async def h_api_create_entry(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        e = Entry(
+            full_path=body["FullPath"],
+            attr=Attr(mtime=body.get("Mtime", time.time()),
+                      crtime=body.get("Crtime", time.time()),
+                      mode=body.get("Mode", 0o660),
+                      uid=body.get("Uid", 0), gid=body.get("Gid", 0),
+                      mime=body.get("Mime", ""),
+                      replication=body.get("Replication", ""),
+                      collection=body.get("Collection", ""),
+                      ttl_sec=body.get("TtlSec", 0)),
+            chunks=[FileChunk.from_dict(c) for c in body.get("chunks", [])],
+            extended=body.get("extended", {}))
+        try:
+            self.filer.create_entry(e)
+        except FilerError as err:
+            return web.json_response({"error": str(err)}, status=400)
+        return web.json_response({"ok": True})
+
+    async def h_api_rename(self, req: web.Request) -> web.Response:
+        try:
+            self.filer.rename_entry(req.query["from"], req.query["to"])
+        except FilerError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"ok": True})
+
+    async def h_api_assign(self, req: web.Request) -> web.Response:
+        try:
+            a = await self.client.assign(
+                collection=req.query.get("collection", self.collection),
+                replication=req.query.get("replication", self.replication),
+                ttl=req.query.get("ttl", ""))
+        except OperationError as e:
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response(a)
+
+    async def h_api_delete(self, req: web.Request) -> web.Response:
+        fids = (await req.json()).get("fids", [])
+        self.filer.delete_chunks(fids)
+        return web.json_response({"ok": True})
+
+
+async def _read_up_to(reader, n: int) -> bytes:
+    """Read exactly n bytes unless EOF; handles both aiohttp StreamReader
+    (short reads possible) and multipart BodyPartReader."""
+    out = bytearray()
+    while len(out) < n:
+        if hasattr(reader, "read_chunk"):
+            chunk = await reader.read_chunk(min(64 * 1024, n - len(out)))
+        else:
+            chunk = await reader.read(n - len(out))
+        if not chunk:
+            break
+        out.extend(chunk)
+    return bytes(out)

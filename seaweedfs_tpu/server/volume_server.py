@@ -199,7 +199,7 @@ class VolumeServer:
             return web.Response(status=404)
         except CrcMismatch as e:
             return web.json_response({"error": str(e)}, status=500)
-        headers = {"Etag": f'"{n.etag()}"'}
+        headers = {"Etag": f'"{n.etag()}"', "Accept-Ranges": "bytes"}
         body = n.data
         if n.is_gzipped:
             if "gzip" in req.headers.get("Accept-Encoding", ""):
@@ -210,9 +210,28 @@ class VolumeServer:
             headers["Last-Modified"] = time.strftime(
                 "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
         ct = n.mime.decode() if n.mime else "application/octet-stream"
+        status = 200
+        if "Content-Encoding" not in headers:
+            # serve byte ranges of the (plain) body so chunked readers
+            # don't transfer whole chunks for small ranges
+            from ..util.httprange import RangeError, parse_range
+            try:
+                rng = parse_range(req.headers.get("Range", ""), len(body))
+            except RangeError:
+                return web.Response(
+                    status=416,
+                    headers={"Content-Range": f"bytes */{len(body)}"})
+            if rng is not None:
+                off, ln = rng
+                headers["Content-Range"] = \
+                    f"bytes {off}-{off+ln-1}/{len(body)}"
+                body = body[off:off + ln]
+                status = 206
         if req.method == "HEAD":
-            return web.Response(status=200, headers=headers, content_type=ct)
-        return web.Response(body=body, headers=headers, content_type=ct)
+            return web.Response(status=status, headers=headers,
+                                content_type=ct)
+        return web.Response(body=body, headers=headers, content_type=ct,
+                            status=status)
 
     async def _needle_from_request(self, req: web.Request,
                                    fid: t.FileId) -> Needle:

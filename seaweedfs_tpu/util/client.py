@@ -1,0 +1,152 @@
+"""Async client SDK for the master/volume tier.
+
+Reference: weed/operation/ (assign_file_id.go, upload_content.go,
+lookup.go w/ 10-min vid cache, delete_content.go batch deletes) and
+weed/wdclient/ (cached master client).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import aiohttp
+
+
+class OperationError(Exception):
+    pass
+
+
+class WeedClient:
+    def __init__(self, master_url: str,
+                 session: aiohttp.ClientSession | None = None,
+                 lookup_cache_ttl: float = 600.0):
+        self.master_url = master_url
+        self._session = session
+        self._own = session is None
+        self._vid_cache: dict[str, tuple[float, list[dict]]] = {}
+        self._cache_ttl = lookup_cache_ttl
+
+    async def __aenter__(self) -> "WeedClient":
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=120))
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self._own and self._session:
+            await self._session.close()
+
+    @property
+    def http(self) -> aiohttp.ClientSession:
+        assert self._session is not None
+        return self._session
+
+    # ---- assign / lookup ----
+
+    async def assign(self, count: int = 1, collection: str = "",
+                     replication: str = "", ttl: str = "",
+                     data_center: str = "") -> dict:
+        params = {"count": str(count)}
+        if collection:
+            params["collection"] = collection
+        if replication:
+            params["replication"] = replication
+        if ttl:
+            params["ttl"] = ttl
+        if data_center:
+            params["dataCenter"] = data_center
+        async with self.http.get(f"http://{self.master_url}/dir/assign",
+                                 params=params) as resp:
+            body = await resp.json()
+        if "error" in body:
+            raise OperationError(f"assign: {body['error']}")
+        return body
+
+    async def lookup(self, vid: str) -> list[dict]:
+        """Volume locations with a TTL cache (lookup.go:10min)."""
+        hit = self._vid_cache.get(vid)
+        now = time.time()
+        if hit and now - hit[0] < self._cache_ttl:
+            return hit[1]
+        async with self.http.get(f"http://{self.master_url}/dir/lookup",
+                                 params={"volumeId": vid}) as resp:
+            body = await resp.json()
+        if "locations" not in body:
+            raise OperationError(f"lookup {vid}: {body.get('error')}")
+        self._vid_cache[vid] = (now, body["locations"])
+        return body["locations"]
+
+    def invalidate(self, vid: str) -> None:
+        self._vid_cache.pop(vid, None)
+
+    async def lookup_file_id(self, fid: str) -> str:
+        vid = fid.split(",")[0]
+        locs = await self.lookup(vid)
+        return f"http://{locs[0]['publicUrl']}/{fid}"
+
+    # ---- data ops ----
+
+    async def upload(self, fid: str, url: str, data: bytes,
+                     mime: str = "", ttl: str = "") -> dict:
+        params = {"ttl": ttl} if ttl else {}
+        headers = {"Content-Type": mime} if mime else {}
+        async with self.http.post(f"http://{url}/{fid}", data=data,
+                                  params=params, headers=headers) as resp:
+            body = await resp.json()
+            if resp.status not in (200, 201):
+                raise OperationError(f"upload {fid}: {body}")
+            return body
+
+    async def upload_data(self, data: bytes, collection: str = "",
+                          replication: str = "", ttl: str = "",
+                          mime: str = "") -> str:
+        """assign + upload; returns the fid."""
+        a = await self.assign(collection=collection,
+                              replication=replication, ttl=ttl)
+        await self.upload(a["fid"], a["url"], data, mime=mime, ttl=ttl)
+        return a["fid"]
+
+    async def read(self, fid: str, offset: int = 0,
+                   size: int = -1) -> bytes:
+        url = await self.lookup_file_id(fid)
+        headers = {}
+        if offset or size >= 0:
+            end = "" if size < 0 else str(offset + size - 1)
+            headers["Range"] = f"bytes={offset}-{end}"
+        async with self.http.get(url, headers=headers) as resp:
+            if resp.status in (404, 410):
+                raise OperationError(f"read {fid}: not found")
+            data = await resp.read()
+        if resp.status == 200 and (offset or size >= 0):
+            # server ignored Range; slice locally
+            data = data[offset:offset + size if size >= 0 else None]
+        return data
+
+    async def delete_fids(self, fids: list[str]) -> int:
+        """Batch delete grouped per volume server
+        (delete_content.go DeleteFilesAtOneVolumeServer)."""
+        by_server: dict[str, list[str]] = {}
+        for fid in fids:
+            try:
+                locs = await self.lookup(fid.split(",")[0])
+            except OperationError:
+                continue
+            for loc in locs:
+                by_server.setdefault(loc["url"], []).append(fid)
+
+        async def drop(server: str, batch: list[str]) -> int:
+            n = 0
+            for fid in batch:
+                try:
+                    async with self.http.delete(
+                            f"http://{server}/{fid}",
+                            params={"type": "replicate"}) as resp:
+                        n += resp.status == 200
+                except aiohttp.ClientError:
+                    pass
+            return n
+
+        counts = await asyncio.gather(
+            *(drop(s, b) for s, b in by_server.items()))
+        return sum(counts)

@@ -9,7 +9,9 @@ import os
 
 import aiohttp
 
+from seaweedfs_tpu.filer.filer import Filer
 from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.server.filer_server import FilerServer
 from seaweedfs_tpu.server.volume_server import VolumeServer
 from seaweedfs_tpu.storage.store import Store
 
@@ -31,7 +33,10 @@ class Cluster:
         self.ec_small_block = ec_small_block
         self.master: MasterServer | None = None
         self.servers: list[VolumeServer] = []
+        self.filer: FilerServer | None = None
         self.http: aiohttp.ClientSession | None = None
+        self.with_filer = False
+        self.filer_chunk_size = 256 * 1024
 
     async def __aenter__(self) -> "Cluster":
         self.master = MasterServer(port=0, pulse_seconds=self.pulse,
@@ -49,6 +54,11 @@ class Cluster:
             await vs.start()
             await vs.heartbeat_once()
             self.servers.append(vs)
+        if self.with_filer:
+            self.filer = FilerServer(Filer("memory"), self.master.url,
+                                     port=0,
+                                     chunk_size=self.filer_chunk_size)
+            await self.filer.start()
         self.http = aiohttp.ClientSession(
             timeout=aiohttp.ClientTimeout(total=30))
         return self
@@ -56,6 +66,9 @@ class Cluster:
     async def __aexit__(self, *exc) -> None:
         if self.http:
             await self.http.close()
+        if self.filer:
+            with contextlib.suppress(Exception):
+                await self.filer.stop()
         for vs in self.servers:
             with contextlib.suppress(Exception):
                 await vs.stop()

@@ -1,0 +1,121 @@
+"""Filer server e2e over the in-proc cluster: auto-chunked uploads,
+streaming range reads, listings, rename, recursive delete + chunk GC."""
+
+import asyncio
+import random
+
+from cluster_util import Cluster, run
+
+
+def _cluster(tmp_path, **kw):
+    c = Cluster(str(tmp_path), **kw)
+    c.with_filer = True
+    return c
+
+
+def test_upload_download_multi_chunk(tmp_path):
+    async def body():
+        async with _cluster(tmp_path) as c:
+            f = c.filer
+            rng = random.Random(9)
+            # 3.5 chunks worth of data (chunk_size = 256KB)
+            data = bytes(rng.getrandbits(8)
+                         for _ in range(int(3.5 * 256 * 1024)))
+            async with c.http.post(
+                    f"http://{f.url}/docs/big.bin", data=data) as resp:
+                assert resp.status == 201, await resp.text()
+            # entry has 4 chunks
+            async with c.http.get(f"http://{f.url}/__api__/lookup",
+                                  params={"path": "/docs/big.bin"}) as resp:
+                meta = await resp.json()
+            assert len(meta["chunks"]) == 4
+            assert meta["FileSize"] == len(data)
+            # full read
+            async with c.http.get(f"http://{f.url}/docs/big.bin") as resp:
+                assert resp.status == 200
+                got = await resp.read()
+            assert got == data
+            # range read across a chunk boundary
+            start, ln = 256 * 1024 - 100, 300
+            async with c.http.get(
+                    f"http://{f.url}/docs/big.bin",
+                    headers={"Range": f"bytes={start}-{start+ln-1}"}) as resp:
+                assert resp.status == 206
+                assert await resp.read() == data[start:start + ln]
+            # suffix range
+            async with c.http.get(
+                    f"http://{f.url}/docs/big.bin",
+                    headers={"Range": "bytes=-100"}) as resp:
+                assert await resp.read() == data[-100:]
+    run(body())
+
+
+def test_listing_and_rename_and_delete(tmp_path):
+    async def body():
+        async with _cluster(tmp_path) as c:
+            f = c.filer
+            for name in ("a.txt", "b.txt"):
+                async with c.http.post(f"http://{f.url}/dir/{name}",
+                                       data=b"data-" + name.encode()) as r:
+                    assert r.status == 201
+            # directory listing
+            async with c.http.get(f"http://{f.url}/dir") as resp:
+                listing = await resp.json()
+            assert [e["FullPath"] for e in listing["Entries"]] == \
+                ["/dir/a.txt", "/dir/b.txt"]
+            # rename directory
+            async with c.http.post(f"http://{f.url}/moved",
+                                   params={"mv.from": "/dir"}) as resp:
+                assert resp.status == 200
+            async with c.http.get(f"http://{f.url}/moved/a.txt") as resp:
+                assert await resp.read() == b"data-a.txt"
+            # recursive delete queues chunk GC
+            async with c.http.delete(f"http://{f.url}/moved",
+                                     params={"recursive": "true"}) as resp:
+                assert resp.status == 204
+            async with c.http.get(f"http://{f.url}/moved/a.txt") as resp:
+                assert resp.status == 404
+            # chunk GC drains: blobs eventually deleted from volume servers
+            for _ in range(30):
+                await asyncio.sleep(0.2)
+                if not f._pending:
+                    break
+            assert not f._pending
+    run(body())
+
+
+def test_overwrite_gc_and_mkdir(tmp_path):
+    async def body():
+        async with _cluster(tmp_path) as c:
+            f = c.filer
+            async with c.http.post(f"http://{f.url}/f.bin",
+                                   data=b"version-1") as r:
+                assert r.status == 201
+            async with c.http.get(f"http://{f.url}/__api__/lookup",
+                                  params={"path": "/f.bin"}) as r:
+                old_fid = (await r.json())["chunks"][0]["file_id"]
+            async with c.http.post(f"http://{f.url}/f.bin",
+                                   data=b"version-2!") as r:
+                assert r.status == 201
+            async with c.http.get(f"http://{f.url}/f.bin") as r:
+                assert await r.read() == b"version-2!"
+            assert old_fid in f._pending  # queued for GC
+            # mkdir
+            async with c.http.post(f"http://{f.url}/newdir",
+                                   params={"mkdir": "true"}) as r:
+                assert r.status == 201
+            async with c.http.get(f"http://{f.url}/__api__/lookup",
+                                  params={"path": "/newdir"}) as r:
+                assert (await r.json())["IsDirectory"] is True
+            # multipart upload form
+            import aiohttp
+            form = aiohttp.FormData()
+            form.add_field("file", b"formdata", filename="form.txt",
+                           content_type="text/plain")
+            async with c.http.post(f"http://{f.url}/up.txt",
+                                   data=form) as r:
+                assert r.status == 201
+            async with c.http.get(f"http://{f.url}/up.txt") as r:
+                assert await r.read() == b"formdata"
+                assert r.headers["Content-Type"].startswith("text/plain")
+    run(body())
