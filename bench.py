@@ -120,6 +120,12 @@ def main() -> None:
     else:  # no chip attached: measure the interpret path on tiny shapes
         tpu = bench_tpu(1 << 20, chain_len=2)
     value = tpu["value"]
+    try:
+        from seaweedfs_tpu.stats import metrics
+        if metrics.HAVE_PROMETHEUS:
+            metrics.EC_THROUGHPUT.set(value)
+    except ImportError:
+        pass
     print(json.dumps({
         "metric": "rs_10_4_encode_rebuild_GBps_per_chip",
         "value": round(value, 2),

@@ -1,0 +1,442 @@
+"""`weed`-style CLI: one entry point, subcommand per server/tool.
+
+Reference: weed/weed.go:37-60 + weed/command/ (command registry,
+command/command.go:10-30). Run as `python -m seaweedfs_tpu.cli <cmd>`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-master", default="127.0.0.1:9333",
+                   help="master host:port")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="weed-tpu",
+                                 description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    m = sub.add_parser("master", help="start a master server")
+    _add_common(m)
+    m.add_argument("-port", type=int, default=9333)
+    m.add_argument("-volumeSizeLimitMB", type=int, default=30_000)
+    m.add_argument("-defaultReplication", default="000")
+    m.add_argument("-pulseSeconds", type=float, default=5.0)
+    m.add_argument("-jwtKey", default="")
+    m.add_argument("-metricsGateway", default="",
+                   help="prometheus push-gateway host:port")
+
+    v = sub.add_parser("volume", help="start a volume server")
+    _add_common(v)
+    v.add_argument("-port", type=int, default=8080)
+    v.add_argument("-dir", default="./data", help="comma-separated dirs")
+    v.add_argument("-max", default="8", help="comma-separated max volumes")
+    v.add_argument("-dataCenter", default="")
+    v.add_argument("-rack", default="")
+    v.add_argument("-pulseSeconds", type=float, default=5.0)
+    v.add_argument("-jwtKey", default="")
+
+    f = sub.add_parser("filer", help="start a filer server")
+    _add_common(f)
+    f.add_argument("-port", type=int, default=8888)
+    f.add_argument("-store", default="sqlite",
+                   help="filer store: memory|sqlite")
+    f.add_argument("-dbPath", default="./filer.db")
+    f.add_argument("-chunkSizeMB", type=int, default=32)
+    f.add_argument("-collection", default="")
+    f.add_argument("-replication", default="")
+
+    s3p = sub.add_parser("s3", help="start an S3 gateway")
+    _add_common(s3p)
+    s3p.add_argument("-port", type=int, default=8333)
+    s3p.add_argument("-store", default="sqlite")
+    s3p.add_argument("-dbPath", default="./s3filer.db")
+
+    srv = sub.add_parser("server",
+                         help="combined master+volume+filer+s3 in one process")
+    _add_common(srv)
+    srv.add_argument("-dir", default="./data")
+    srv.add_argument("-masterPort", type=int, default=9333)
+    srv.add_argument("-volumePort", type=int, default=8080)
+    srv.add_argument("-filerPort", type=int, default=8888)
+    srv.add_argument("-s3Port", type=int, default=8333)
+    srv.add_argument("-s3", action="store_true")
+    srv.add_argument("-filer", action="store_true")
+    srv.add_argument("-jwtKey", default="")
+
+    up = sub.add_parser("upload", help="upload files via assign+PUT")
+    _add_common(up)
+    up.add_argument("files", nargs="+")
+    up.add_argument("-collection", default="")
+    up.add_argument("-replication", default="")
+    up.add_argument("-ttl", default="")
+
+    dl = sub.add_parser("download", help="download a fid")
+    _add_common(dl)
+    dl.add_argument("fid")
+    dl.add_argument("-o", dest="output", default="")
+
+    sh = sub.add_parser("shell", help="admin shell (interactive or -c)")
+    _add_common(sh)
+    sh.add_argument("-c", dest="command", default="",
+                    help="run one command and exit, e.g. 'ec.encode "
+                         "-collection x'")
+
+    bm = sub.add_parser("benchmark", help="write/read throughput benchmark")
+    _add_common(bm)
+    bm.add_argument("-n", type=int, default=1024)
+    bm.add_argument("-size", type=int, default=1024)
+    bm.add_argument("-c", dest="concurrency", type=int, default=16)
+    bm.add_argument("-collection", default="benchmark")
+
+    fx = sub.add_parser("fix", help="rebuild .idx by scanning .dat")
+    fx.add_argument("-dir", default=".")
+    fx.add_argument("-volumeId", type=int, required=True)
+    fx.add_argument("-collection", default="")
+
+    ex = sub.add_parser("export", help="list/dump needles in a volume")
+    ex.add_argument("-dir", default=".")
+    ex.add_argument("-volumeId", type=int, required=True)
+    ex.add_argument("-collection", default="")
+
+    co = sub.add_parser("compact", help="offline-compact one volume")
+    co.add_argument("-dir", default=".")
+    co.add_argument("-volumeId", type=int, required=True)
+    co.add_argument("-collection", default="")
+
+    sc = sub.add_parser("scaffold", help="print example config TOML")
+    sc.add_argument("-config", default="security",
+                    choices=["security", "master", "filer"])
+
+    sub.add_parser("version", help="print version")
+    bench = sub.add_parser("bench-ec", help="TPU EC kernel benchmark "
+                                            "(bench.py)")
+    return ap
+
+
+# ---------------------------------------------------------------------------
+
+
+async def _run_master(args) -> None:
+    from .master.server import MasterServer
+    m = MasterServer(ip=args.ip, port=args.port,
+                     volume_size_limit_mb=args.volumeSizeLimitMB,
+                     default_replication=args.defaultReplication,
+                     pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey)
+    await m.start()
+    if args.metricsGateway:
+        from .stats.metrics import push_loop
+        asyncio.create_task(push_loop(args.metricsGateway, "master"))
+    print(f"master listening on {m.url}")
+    await asyncio.Event().wait()
+
+
+async def _run_volume(args) -> None:
+    from .server.volume_server import VolumeServer
+    from .storage.store import Store
+    dirs = args.dir.split(",")
+    maxes = [int(x) for x in args.max.split(",")]
+    if len(maxes) == 1:
+        maxes = maxes * len(dirs)
+    store = Store(dirs, max_volume_counts=maxes)
+    vs = VolumeServer(store, args.master, ip=args.ip, port=args.port,
+                      data_center=args.dataCenter, rack=args.rack,
+                      pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey)
+    await vs.start()
+    print(f"volume server listening on {vs.url}, dirs={dirs}")
+    await asyncio.Event().wait()
+
+
+async def _run_filer(args) -> None:
+    from .filer.filer import Filer
+    from .server.filer_server import FilerServer
+    kwargs = {"path": args.dbPath} if args.store == "sqlite" else {}
+    fs = FilerServer(Filer(args.store, **kwargs), args.master,
+                     ip=args.ip, port=args.port,
+                     chunk_size=args.chunkSizeMB * 1024 * 1024,
+                     collection=args.collection,
+                     replication=args.replication)
+    await fs.start()
+    print(f"filer listening on {fs.url} (store={args.store})")
+    await asyncio.Event().wait()
+
+
+async def _run_s3(args) -> None:
+    from .filer.filer import Filer
+    from .s3.gateway import S3Gateway
+    kwargs = {"path": args.dbPath} if args.store == "sqlite" else {}
+    s3 = S3Gateway(Filer(args.store, **kwargs), args.master,
+                   ip=args.ip, port=args.port)
+    await s3.start()
+    print(f"s3 gateway listening on {s3.url}")
+    await asyncio.Event().wait()
+
+
+async def _run_server(args) -> None:
+    """`weed server` combined launcher (command/server.go:103+)."""
+    from .filer.filer import Filer
+    from .master.server import MasterServer
+    from .s3.gateway import S3Gateway
+    from .server.filer_server import FilerServer
+    from .server.volume_server import VolumeServer
+    from .storage.store import Store
+
+    m = MasterServer(ip=args.ip, port=args.masterPort, jwt_key=args.jwtKey)
+    await m.start()
+    store = Store([args.dir])
+    vs = VolumeServer(store, m.url, ip=args.ip, port=args.volumePort,
+                      jwt_key=args.jwtKey)
+    await vs.start()
+    await vs.heartbeat_once()
+    parts = [f"master={m.url}", f"volume={vs.url}"]
+    filer_srv = None
+    if args.filer or args.s3:
+        filer_srv = FilerServer(
+            Filer("sqlite", path=os.path.join(args.dir, "filer.db")),
+            m.url, ip=args.ip, port=args.filerPort)
+        await filer_srv.start()
+        parts.append(f"filer={filer_srv.url}")
+    if args.s3:
+        s3 = S3Gateway(filer_srv.filer, m.url, ip=args.ip, port=args.s3Port)
+        await s3.start()
+        parts.append(f"s3={s3.url}")
+    print("server up: " + " ".join(parts))
+    await asyncio.Event().wait()
+
+
+async def _run_upload(args) -> None:
+    from .util.client import WeedClient
+    async with WeedClient(args.master) as c:
+        out = []
+        for path in args.files:
+            with open(path, "rb") as f:
+                data = f.read()
+            fid = await c.upload_data(data, collection=args.collection,
+                                      replication=args.replication,
+                                      ttl=args.ttl)
+            out.append({"fileName": os.path.basename(path), "fid": fid,
+                        "size": len(data),
+                        "fileUrl": await c.lookup_file_id(fid)})
+        print(json.dumps(out, indent=2))
+
+
+async def _run_download(args) -> None:
+    from .util.client import WeedClient
+    async with WeedClient(args.master) as c:
+        data = await c.read(args.fid)
+    out = args.output or args.fid.replace(",", "_")
+    with open(out, "wb") as f:
+        f.write(data)
+    print(f"wrote {len(data)} bytes to {out}")
+
+
+async def _run_shell(args) -> None:
+    from .shell.runner import run_command, HELP
+    if args.command:
+        await run_command(args.master, args.command)
+        return
+    print("seaweedfs_tpu shell; 'help' for commands, 'exit' to quit")
+    loop = asyncio.get_running_loop()
+    while True:
+        try:
+            line = await loop.run_in_executor(None, input, "> ")
+        except (EOFError, KeyboardInterrupt):
+            break
+        line = line.strip()
+        if line in ("exit", "quit"):
+            break
+        if line == "help":
+            print(HELP)
+            continue
+        if line:
+            try:
+                await run_command(args.master, line)
+            except Exception as e:
+                print(f"error: {e}")
+
+
+async def _run_benchmark(args) -> None:
+    """weed benchmark analog (command/benchmark.go): concurrent 1KB
+    writes + reads with latency percentiles."""
+    import random
+
+    from .util.client import WeedClient
+
+    rng = random.Random(0)
+    payload = bytes(rng.getrandbits(8) for _ in range(args.size))
+    write_lat: list[float] = []
+    read_lat: list[float] = []
+    fids: list[str] = []
+
+    async with WeedClient(args.master) as c:
+        sem = asyncio.Semaphore(args.concurrency)
+
+        async def write_one(i: int):
+            async with sem:
+                t0 = time.perf_counter()
+                fid = await c.upload_data(payload,
+                                          collection=args.collection)
+                write_lat.append(time.perf_counter() - t0)
+                fids.append(fid)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(write_one(i) for i in range(args.n)))
+        wdt = time.perf_counter() - t0
+
+        async def read_one(fid: str):
+            async with sem:
+                t0 = time.perf_counter()
+                await c.read(fid)
+                read_lat.append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(read_one(f) for f in fids))
+        rdt = time.perf_counter() - t0
+
+    def pct(xs, p):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(p / 100 * len(xs)))] * 1e3
+
+    print(f"write: {args.n / wdt:.1f} req/s, "
+          f"{args.n * args.size / wdt / 1024:.1f} KB/s")
+    print(f"  latency ms p50/p95/p99/max: {pct(write_lat, 50):.1f}/"
+          f"{pct(write_lat, 95):.1f}/{pct(write_lat, 99):.1f}/"
+          f"{max(write_lat) * 1e3:.1f}")
+    print(f"read:  {len(fids) / rdt:.1f} req/s, "
+          f"{len(fids) * args.size / rdt / 1024:.1f} KB/s")
+    print(f"  latency ms p50/p95/p99/max: {pct(read_lat, 50):.1f}/"
+          f"{pct(read_lat, 95):.1f}/{pct(read_lat, 99):.1f}/"
+          f"{max(read_lat) * 1e3:.1f}")
+
+
+def _run_fix(args) -> None:
+    """Rebuild .idx by scanning .dat (command/fix.go)."""
+    from .storage import types as t
+    from .storage.needle_map import _ENTRY
+    from .storage.volume import Volume
+    v = Volume(args.dir, args.collection, args.volumeId,
+               create_if_missing=False)
+    entries: dict[int, tuple[int, int]] = {}
+
+    def visit(n, offset):
+        if n.size > 0 or n.data:
+            entries[n.id] = (offset, n.size)
+        else:
+            entries[n.id] = (0, t.TOMBSTONE_FILE_SIZE)
+    v.scan(visit)
+    idx_path = v.file_name() + ".idx"
+    with open(idx_path, "wb") as f:
+        for key, (off, size) in entries.items():
+            f.write(_ENTRY.pack(key, off // 8, size))
+    print(f"rebuilt {idx_path} with {len(entries)} entries")
+    v.close()
+
+
+def _run_export(args) -> None:
+    from .storage.volume import Volume
+    v = Volume(args.dir, args.collection, args.volumeId,
+               create_if_missing=False)
+
+    def visit(n, offset):
+        kind = "tombstone" if n.size == 0 and not n.data else "needle"
+        print(json.dumps({
+            "key": n.id, "cookie": n.cookie, "size": n.size,
+            "offset": offset, "name": n.name.decode(errors="replace"),
+            "mime": n.mime.decode(errors="replace"), "type": kind}))
+    v.scan(visit)
+    v.close()
+
+
+def _run_compact(args) -> None:
+    from .storage import vacuum
+    from .storage.volume import Volume
+    v = Volume(args.dir, args.collection, args.volumeId,
+               create_if_missing=False)
+    before = v.data_size()
+    vacuum.compact(v)
+    vacuum.commit_compact(v)
+    print(f"compacted volume {args.volumeId}: {before} -> {v.data_size()} "
+          f"bytes")
+    v.close()
+
+
+_SCAFFOLDS = {
+    "security": """# security.toml (reference: weed scaffold -config=security)
+[jwt.signing]
+key = ""            # base64 or raw secret; empty disables write tokens
+expires_after_seconds = 10
+""",
+    "master": """# master.toml
+[master.maintenance]
+scripts = \"\"\"
+  ec.encode -fullPercent=95 -quietFor=1h
+  ec.rebuild -force
+  ec.balance -force
+  volume.balance -force
+\"\"\"
+sleep_minutes = 17
+[master.sequencer]
+type = "memory"
+""",
+    "filer": """# filer.toml
+[memory]
+enabled = false
+[sqlite]
+enabled = true
+path = "./filer.db"
+""",
+}
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "version":
+        from . import __version__
+        print(f"seaweedfs_tpu {__version__}")
+        return
+    if args.cmd == "scaffold":
+        try:
+            print(_SCAFFOLDS[args.config])
+        except BrokenPipeError:
+            os._exit(0)
+        return
+    if args.cmd == "fix":
+        _run_fix(args)
+        return
+    if args.cmd == "export":
+        _run_export(args)
+        return
+    if args.cmd == "compact":
+        _run_compact(args)
+        return
+    if args.cmd == "bench-ec":
+        import subprocess
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        subprocess.run([sys.executable, os.path.join(repo, "bench.py")])
+        return
+    runners = {
+        "master": _run_master, "volume": _run_volume, "filer": _run_filer,
+        "s3": _run_s3, "server": _run_server, "upload": _run_upload,
+        "download": _run_download, "shell": _run_shell,
+        "benchmark": _run_benchmark,
+    }
+    try:
+        asyncio.run(runners[args.cmd](args))
+    except KeyboardInterrupt:
+        pass
+    except BrokenPipeError:
+        # stdout piped to a closed reader (e.g. `| head`)
+        os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,6 +108,12 @@ def _encode_one_block_row(f, start: int, block_size: int, buf_size: int,
                 raw = raw + b"\x00" * (buf_size - len(raw))
             buffers.append(np.frombuffer(raw, np.uint8))
         parities = _transform_buffers(encoder, parity, buffers)
+        try:
+            from ..stats import metrics
+            if metrics.HAVE_PROMETHEUS:
+                metrics.EC_ENCODE_BYTES.inc(sum(len(b) for b in buffers))
+        except ImportError:
+            pass
         for i in range(gf.DATA_SHARDS):
             outs[i].write(buffers[i].tobytes())
         for p, buf in enumerate(parities):

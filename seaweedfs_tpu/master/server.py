@@ -30,9 +30,11 @@ class MasterServer:
                  volume_size_limit_mb: int = 30_000,
                  default_replication: str = "000",
                  pulse_seconds: float = 5.0,
-                 garbage_threshold: float = 0.3):
+                 garbage_threshold: float = 0.3,
+                 jwt_key: str = ""):
         self.ip = ip
         self.port = port
+        self.jwt_key = jwt_key
         self.volume_size_limit = volume_size_limit_mb * 1024 * 1024
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
@@ -44,6 +46,7 @@ class MasterServer:
         self._site: web.TCPSite | None = None
         self._tasks: list[asyncio.Task] = []
         self._http: aiohttp.ClientSession | None = None
+        self._grow_lock = asyncio.Lock()
         self.app = self._build_app()
 
     # ------------------------------------------------------------------
@@ -56,6 +59,7 @@ class MasterServer:
         app.router.add_post("/cluster/heartbeat", self.h_heartbeat)
         app.router.add_get("/cluster/watch", self.h_watch)
         app.router.add_get("/stats/health", self.h_health)
+        app.router.add_get("/metrics", self.h_metrics)
         app.router.add_route("*", "/vol/grow", self.h_grow)
         app.router.add_route("*", "/col/delete", self.h_collection_delete)
         app.router.add_get("/vol/volumes", self.h_volumes)
@@ -112,7 +116,15 @@ class MasterServer:
     async def h_health(self, req: web.Request) -> web.Response:
         return web.json_response({"ok": True})
 
+    async def h_metrics(self, req: web.Request) -> web.Response:
+        from ..stats.metrics import metrics_text
+        return web.Response(body=metrics_text(),
+                            content_type="text/plain")
+
     async def h_heartbeat(self, req: web.Request) -> web.Response:
+        from ..stats import metrics
+        if metrics.HAVE_PROMETHEUS:
+            metrics.MASTER_RECEIVED_HEARTBEATS.inc()
         hb = pb.Heartbeat.from_dict(await req.json())
         node = self.topo.register_heartbeat(hb)
         self.seq.set_max(hb.max_file_key)
@@ -147,23 +159,36 @@ class MasterServer:
         lay = self._layout(collection, replication, ttl)
         vid = lay.pick_for_write(self.topo, rp.copy_count)
         if vid is None:
-            try:
-                await self._grow(lay, rp, collection, replication, ttl,
-                                 data_center)
-            except PlacementError as e:
-                return web.json_response({"error": str(e)}, status=500)
-            vid = lay.pick_for_write(self.topo, rp.copy_count)
+            # serialize growth: concurrent assigns must not each grow a
+            # volume and overshoot node capacity (vgChan in the reference)
+            async with self._grow_lock:
+                vid = lay.pick_for_write(self.topo, rp.copy_count)
+                if vid is None:
+                    try:
+                        await self._grow(lay, rp, collection, replication,
+                                         ttl, data_center)
+                    except PlacementError as e:
+                        return web.json_response({"error": str(e)},
+                                                 status=500)
+                    vid = lay.pick_for_write(self.topo, rp.copy_count)
             if vid is None:
                 return web.json_response(
                     {"error": "no writable volumes after growth"}, status=500)
+        from ..stats import metrics
+        if metrics.HAVE_PROMETHEUS:
+            metrics.MASTER_ASSIGN_REQUESTS.labels("ok").inc()
         key = self.seq.next_file_id(count)
         fid = str(t.FileId(vid, key, t.random_cookie()))
         nodes = self.topo.lookup(vid)
         node = nodes[0]
-        return web.json_response({
+        out = {
             "fid": fid, "url": node.url, "publicUrl": node.public_url,
             "count": count,
-        })
+        }
+        if self.jwt_key:
+            from ..security.jwt import gen_jwt
+            out["auth"] = gen_jwt(self.jwt_key, fid)
+        return web.json_response(out)
 
     async def _grow(self, lay: VolumeLayout, rp: ReplicaPlacement,
                     collection: str, replication: str, ttl: str,

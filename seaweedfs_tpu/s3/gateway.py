@@ -330,7 +330,8 @@ class S3Gateway:
             md5.update(data)
             a = await self.client.assign(collection=collection)
             up = await self.client.upload(a["fid"], a["url"], bytes(data),
-                                          mime=mime)
+                                          mime=mime,
+                                          auth=a.get("auth", ""))
             chunks.append(FileChunk(a["fid"], offset, len(data),
                                     time.time_ns(), up.get("eTag", "")))
             offset += len(data)
@@ -352,7 +353,8 @@ class S3Gateway:
                                           view.size)
             a = await self.client.assign(
                 collection=dst_path.split("/")[2])
-            up = await self.client.upload(a["fid"], a["url"], data)
+            up = await self.client.upload(a["fid"], a["url"], data,
+                                          auth=a.get("auth", ""))
             new_chunks.append(FileChunk(
                 a["fid"], view.logic_offset, view.size, time.time_ns(),
                 up.get("eTag", "")))

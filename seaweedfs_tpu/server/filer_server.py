@@ -40,7 +40,22 @@ class FilerServer:
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=4 * 1024 * 1024 * 1024)
+        from ..stats import metrics
+
+        @web.middleware
+        async def timing(request, handler):
+            t0 = time.perf_counter()
+            try:
+                return await handler(request)
+            finally:
+                if metrics.HAVE_PROMETHEUS:
+                    kind = "read" if request.method in ("GET", "HEAD") \
+                        else "write"
+                    metrics.FILER_REQUEST_TIME.labels(kind).observe(
+                        time.perf_counter() - t0)
+
+        app = web.Application(client_max_size=4 * 1024 * 1024 * 1024,
+                              middlewares=[timing])
         api = [
             ("POST", "/__api__/rename", self.h_api_rename),
             ("GET", "/__api__/lookup", self.h_api_lookup),
@@ -235,7 +250,8 @@ class FilerServer:
                 a = await self.client.assign(
                     collection=collection, replication=replication, ttl=ttl)
                 up = await self.client.upload(a["fid"], a["url"], data,
-                                              mime=mime, ttl=ttl)
+                                              mime=mime, ttl=ttl,
+                                              auth=a.get("auth", ""))
                 chunks.append(FileChunk(
                     file_id=a["fid"], offset=offset, size=len(data),
                     mtime=time.time_ns(), etag=up.get("eTag", "")))

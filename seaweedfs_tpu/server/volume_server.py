@@ -32,7 +32,9 @@ class VolumeServer:
                  ip: str = "127.0.0.1", port: int = 8080,
                  data_center: str = "", rack: str = "",
                  pulse_seconds: float = 5.0,
-                 read_redirect: bool = True):
+                 read_redirect: bool = True,
+                 jwt_key: str = ""):
+        self.jwt_key = jwt_key
         self.store = store
         self.master_url = master_url
         self.ip = ip
@@ -54,6 +56,13 @@ class VolumeServer:
         app.router.add_post("/admin/volume/allocate", self.h_allocate)
         app.router.add_post("/admin/volume/delete", self.h_volume_delete)
         app.router.add_post("/admin/volume/readonly", self.h_readonly)
+        app.router.add_post("/admin/volume/mount", self.h_volume_mount)
+        app.router.add_post("/admin/volume/unmount", self.h_volume_unmount)
+        app.router.add_post("/admin/volume/copy", self.h_volume_copy)
+        app.router.add_post("/admin/vacuum/check", self.h_vacuum_check)
+        app.router.add_post("/admin/vacuum/compact", self.h_vacuum_compact)
+        app.router.add_post("/admin/vacuum/commit", self.h_vacuum_commit)
+        app.router.add_post("/admin/vacuum/cleanup", self.h_vacuum_cleanup)
         app.router.add_post("/admin/ec/generate", self.h_ec_generate)
         app.router.add_post("/admin/ec/rebuild", self.h_ec_rebuild)
         app.router.add_post("/admin/ec/mount", self.h_ec_mount)
@@ -63,6 +72,7 @@ class VolumeServer:
         app.router.add_get("/admin/ec/shard_read", self.h_ec_shard_read)
         app.router.add_get("/admin/file", self.h_admin_file)
         app.router.add_get("/status", self.h_status)
+        app.router.add_get("/metrics", self.h_metrics)
         # public needle API — catch-all LAST
         app.router.add_route("GET", "/{fid:[^/]+}", self.h_get)
         app.router.add_route("HEAD", "/{fid:[^/]+}", self.h_get)
@@ -133,6 +143,9 @@ class VolumeServer:
     # ---- heartbeat loop ----
 
     async def heartbeat_once(self) -> None:
+        from ..stats import metrics
+        if metrics.HAVE_PROMETHEUS:
+            metrics.VOLUME_COUNT.set(len(self.store.volumes))
         hb = self.store.collect_heartbeat(self.data_center, self.rack)
         try:
             async with self._http.post(
@@ -189,13 +202,21 @@ class VolumeServer:
                                          status=404)
             raise web.HTTPMovedPermanently(
                 f"http://{others[0]['publicUrl']}/{req.match_info['fid']}")
+        from ..stats import metrics
         try:
             # disk (and possibly remote-shard) I/O: keep off the event loop
             loop = asyncio.get_running_loop()
+            t0 = time.perf_counter()
             n = await loop.run_in_executor(
                 None, lambda: self.store.read_needle(
                     fid.volume_id, fid.key, fid.cookie))
+            if metrics.HAVE_PROMETHEUS:
+                metrics.VOLUME_REQUEST_TIME.labels("read").observe(
+                    time.perf_counter() - t0)
+                metrics.VOLUME_REQUEST_COUNTER.labels("read", "ok").inc()
         except (NotFound, AlreadyDeleted):
+            if metrics.HAVE_PROMETHEUS:
+                metrics.VOLUME_REQUEST_COUNTER.labels("read", "404").inc()
             return web.Response(status=404)
         except CrcMismatch as e:
             return web.json_response({"error": str(e)}, status=500)
@@ -261,7 +282,27 @@ class VolumeServer:
         n.set_flag(FLAG_HAS_LAST_MODIFIED)
         return n
 
+    def _check_jwt(self, req: web.Request) -> web.Response | None:
+        """Write-token guard (volume_server_handlers_write.go:41-44).
+        Replica writes must carry the forwarded per-fid token — a bare
+        ?type=replicate does NOT bypass the guard."""
+        if not self.jwt_key:
+            return None
+        from ..security.jwt import (JwtError, check_write_jwt,
+                                    get_jwt_from_request)
+        token = get_jwt_from_request(req.headers, req.query)
+        if not token:
+            return web.json_response({"error": "missing jwt"}, status=401)
+        try:
+            check_write_jwt(self.jwt_key, token, req.match_info["fid"])
+        except JwtError as e:
+            return web.json_response({"error": str(e)}, status=401)
+        return None
+
     async def h_post(self, req: web.Request) -> web.Response:
+        denied = self._check_jwt(req)
+        if denied is not None:
+            return denied
         try:
             fid = self._parse_fid(req.match_info["fid"])
         except ValueError as e:
@@ -271,10 +312,16 @@ class VolumeServer:
             n = Needle.from_bytes(await req.read(), t.CURRENT_VERSION)
         else:
             n = await self._needle_from_request(req, fid)
+        from ..stats import metrics
         try:
             loop = asyncio.get_running_loop()
+            t0 = time.perf_counter()
             _, size = await loop.run_in_executor(
                 None, lambda: self.store.write_needle(fid.volume_id, n))
+            if metrics.HAVE_PROMETHEUS:
+                metrics.VOLUME_REQUEST_TIME.labels("write").observe(
+                    time.perf_counter() - t0)
+                metrics.VOLUME_REQUEST_COUNTER.labels("write", "ok").inc()
         except NotFound:
             return web.json_response({"error": "volume not found"},
                                      status=404)
@@ -285,8 +332,9 @@ class VolumeServer:
             v = self.store.volumes.get(fid.volume_id)
             rp = v.super_block.replica_placement if v else None
             if rp and rp.copy_count > 1:
-                ok = await self._replicate(req.match_info["fid"],
-                                           "POST", n.to_bytes(3))
+                ok = await self._replicate(
+                    req.match_info["fid"], "POST", n.to_bytes(3),
+                    auth=req.headers.get("Authorization", ""))
                 if not ok:
                     return web.json_response(
                         {"error": "replication failed"}, status=500)
@@ -295,6 +343,9 @@ class VolumeServer:
              "eTag": n.etag()}, status=201)
 
     async def h_delete(self, req: web.Request) -> web.Response:
+        denied = self._check_jwt(req)
+        if denied is not None:
+            return denied
         try:
             fid = self._parse_fid(req.match_info["fid"])
         except ValueError as e:
@@ -309,20 +360,22 @@ class VolumeServer:
             return web.json_response({"error": "volume not found"},
                                      status=404)
         if req.query.get("type") != "replicate":
+            auth = req.headers.get("Authorization", "")
             if is_ec:
                 # tombstone every shard holder's .ecx
                 # (DeleteEcShardNeedle broadcast, store_ec_delete.go:15-101)
                 await self._ec_delete_broadcast(fid.volume_id,
-                                                req.match_info["fid"])
+                                                req.match_info["fid"], auth)
             else:
                 v = self.store.volumes.get(fid.volume_id)
                 rp = v.super_block.replica_placement if v else None
                 if rp and rp.copy_count > 1:
                     await self._replicate(req.match_info["fid"],
-                                          "DELETE", None)
+                                          "DELETE", None, auth=auth)
         return web.json_response({"size": size})
 
-    async def _ec_delete_broadcast(self, vid: int, fid: str) -> None:
+    async def _ec_delete_broadcast(self, vid: int, fid: str,
+                                   auth: str = "") -> None:
         try:
             async with self._http.get(
                     f"http://{self.master_url}/vol/ec_lookup",
@@ -334,11 +387,14 @@ class VolumeServer:
             return
         targets = {u for urls in shards.values() for u in urls} - {self.url}
 
+        headers = {"Authorization": auth} if auth else {}
+
         async def one(target: str) -> None:
             try:
                 async with self._http.delete(
                         f"http://{target}/{fid}",
-                        params={"type": "replicate"}) as r:
+                        params={"type": "replicate"},
+                        headers=headers) as r:
                     await r.read()
             except aiohttp.ClientError:
                 pass
@@ -346,7 +402,8 @@ class VolumeServer:
         await asyncio.gather(*(one(u) for u in targets))
 
     async def _replicate(self, fid: str, method: str,
-                         raw_needle: bytes | None) -> bool:
+                         raw_needle: bytes | None,
+                         auth: str = "") -> bool:
         """Fan out to the other replica locations
         (distributedOperation, store_replicate.go:140-155)."""
         vid = fid.split(",")[0]
@@ -361,6 +418,8 @@ class VolumeServer:
             return False
         targets = [l["url"] for l in locs if l["url"] != self.url]
 
+        extra = {"Authorization": auth} if auth else {}
+
         async def one(target: str) -> bool:
             try:
                 if method == "POST":
@@ -368,11 +427,12 @@ class VolumeServer:
                             f"http://{target}/{fid}",
                             params={"type": "replicate"},
                             data=raw_needle,
-                            headers={"X-Raw-Needle": "1"}) as r:
+                            headers={"X-Raw-Needle": "1", **extra}) as r:
                         return r.status in (200, 201)
                 async with self._http.delete(
                         f"http://{target}/{fid}",
-                        params={"type": "replicate"}) as r:
+                        params={"type": "replicate"},
+                        headers=extra) as r:
                     return r.status == 200
             except aiohttp.ClientError:
                 return False
@@ -381,6 +441,11 @@ class VolumeServer:
         return all(results)
 
     # ---- admin handlers ----
+
+    async def h_metrics(self, req: web.Request) -> web.Response:
+        from ..stats.metrics import metrics_text
+        return web.Response(body=metrics_text(),
+                            content_type="text/plain")
 
     async def h_status(self, req: web.Request) -> web.Response:
         vols = [self.store._volume_message(v).to_dict()
@@ -408,6 +473,101 @@ class VolumeServer:
 
     async def h_readonly(self, req: web.Request) -> web.Response:
         self.store.mark_readonly(int(req.query["volume"]))
+        return web.json_response({"ok": True})
+
+    async def h_volume_mount(self, req: web.Request) -> web.Response:
+        """Load an on-disk volume into the store (VolumeMount)."""
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(
+                None, lambda: self.store.mount_volume(collection, vid))
+        except VolumeError as e:
+            return web.json_response({"error": str(e)}, status=404)
+        return web.json_response({"ok": True})
+
+    async def h_volume_unmount(self, req: web.Request) -> web.Response:
+        self.store.unmount_volume(int(req.query["volume"]))
+        return web.json_response({"ok": True})
+
+    async def h_volume_copy(self, req: web.Request) -> web.Response:
+        """Pull .idx then .dat from a source server, then mount
+        (VolumeCopy, server/volume_grpc_copy.go). .idx is copied first so a
+        racing write at most leaves extra .dat tail beyond the last copied
+        index entry, which the mount-time integrity check truncates."""
+        q = req.query
+        vid = int(q["volume"])
+        collection = q.get("collection", "")
+        source = q["source"]
+        if vid in self.store.volumes:
+            return web.json_response({"error": "already have volume"},
+                                     status=409)
+        d = self.store.dirs[0]
+        base = os.path.join(
+            d, f"{collection}_{vid}" if collection else str(vid))
+
+        async def fetch(ext: str) -> str | None:
+            try:
+                async with self._http.get(
+                        f"http://{source}/admin/file",
+                        params={"volume": str(vid), "collection": collection,
+                                "ext": ext}) as resp:
+                    if resp.status != 200:
+                        return f"fetch {ext}: {resp.status}"
+                    with open(base + ext, "wb") as f:
+                        async for chunk in resp.content.iter_chunked(1 << 20):
+                            f.write(chunk)
+                    return None
+            except aiohttp.ClientError as e:
+                return str(e)
+
+        err = await fetch(".idx") or await fetch(".dat")
+        if err:
+            for ext in (".idx", ".dat"):
+                if os.path.exists(base + ext):
+                    os.remove(base + ext)
+            return web.json_response({"error": err}, status=502)
+        return await self.h_volume_mount(req)
+
+    # ---- vacuum (volume_vacuum.go + topology_vacuum.go protocol) ----
+
+    async def h_vacuum_check(self, req: web.Request) -> web.Response:
+        vid = int(req.query["volume"])
+        v = self.store.volumes.get(vid)
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({"garbage_ratio": v.garbage_level()})
+
+    async def h_vacuum_compact(self, req: web.Request) -> web.Response:
+        from ..storage import vacuum
+        vid = int(req.query["volume"])
+        v = self.store.volumes.get(vid)
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, lambda: vacuum.compact(v))
+        return web.json_response({"ok": True})
+
+    async def h_vacuum_commit(self, req: web.Request) -> web.Response:
+        from ..storage import vacuum
+        vid = int(req.query["volume"])
+        v = self.store.volumes.get(vid)
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, lambda: vacuum.commit_compact(v))
+        except vacuum.VacuumError as e:
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({"ok": True})
+
+    async def h_vacuum_cleanup(self, req: web.Request) -> web.Response:
+        from ..storage import vacuum
+        vid = int(req.query["volume"])
+        v = self.store.volumes.get(vid)
+        if v is not None:
+            vacuum.cleanup_compact(v)
         return web.json_response({"ok": True})
 
     def _base_name(self, vid: int, collection: str) -> str | None:

@@ -1,0 +1,83 @@
+"""Shell command dispatch: parse 'ec.encode -collection x' style lines.
+
+Reference: weed/shell/commands.go registry + shell_liner.go REPL.
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+
+from . import ec_commands as ec
+from . import volume_commands as vc
+from .env import CommandEnv
+
+HELP = """commands:
+  ec.encode    [-collection c] [-volumeId n] [-fullPercent 95]
+  ec.rebuild   [-collection c] [-force]
+  ec.balance   [-collection c] [-force]
+  volume.vacuum          [-garbageThreshold 0.3] [-collection c]
+  volume.fix.replication [-force]
+  volume.balance         [-force]
+  volume.move  -volumeId n -source host:port -target host:port
+  volume.list
+"""
+
+
+def _flags(tokens: list[str]) -> dict[str, str]:
+    out = {}
+    i = 0
+    while i < len(tokens):
+        tok = tokens[i]
+        if tok.startswith("-"):
+            if i + 1 < len(tokens) and not tokens[i + 1].startswith("-"):
+                out[tok.lstrip("-")] = tokens[i + 1]
+                i += 2
+            else:
+                out[tok.lstrip("-")] = "true"
+                i += 1
+        else:
+            i += 1
+    return out
+
+
+async def run_command(master_url: str, line: str) -> object:
+    tokens = shlex.split(line)
+    if not tokens:
+        return None
+    cmd, flags = tokens[0], _flags(tokens[1:])
+    async with CommandEnv(master_url) as env:
+        if cmd == "ec.encode":
+            vids = [int(flags["volumeId"])] if "volumeId" in flags else None
+            res = await ec.ec_encode(
+                env, collection=flags.get("collection", ""), vids=vids,
+                fullness=float(flags.get("fullPercent", 95)) / 100)
+        elif cmd == "ec.rebuild":
+            res = await ec.ec_rebuild(
+                env, collection=flags.get("collection", ""),
+                apply_changes=flags.get("force") == "true")
+        elif cmd == "ec.balance":
+            res = await ec.ec_balance(
+                env, collection=flags.get("collection", ""),
+                apply_changes=flags.get("force") == "true")
+        elif cmd == "volume.vacuum":
+            res = await vc.volume_vacuum(
+                env, float(flags.get("garbageThreshold", 0.3)),
+                flags.get("collection"))
+        elif cmd == "volume.fix.replication":
+            res = await vc.volume_fix_replication(
+                env, apply_changes=flags.get("force") == "true")
+        elif cmd == "volume.balance":
+            res = await vc.volume_balance(
+                env, apply_changes=flags.get("force") == "true")
+        elif cmd == "volume.move":
+            await vc.volume_move(env, int(flags["volumeId"]),
+                                 flags.get("collection", ""),
+                                 flags["source"], flags["target"])
+            res = {"moved": flags["volumeId"]}
+        elif cmd == "volume.list":
+            res = await env.list_nodes()
+        else:
+            raise ValueError(f"unknown command {cmd!r}; try 'help'")
+    print(json.dumps(res, indent=2, default=str))
+    return res

@@ -1,0 +1,136 @@
+"""Volume admin workflows: volume.vacuum / volume.fix.replication /
+volume.balance / volume.move.
+
+Reference: weed/topology/topology_vacuum.go:16-120 (check -> compact ->
+commit across replicas), shell/command_volume_fix_replication.go
+(re-replicate under-replicated volumes rack-aware), command_volume_balance.go
+(even out volume counts), command_volume_move.go.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..storage.super_block import ReplicaPlacement
+from .env import CommandEnv
+
+
+async def volume_vacuum(env: CommandEnv, garbage_threshold: float = 0.3,
+                        collection: str | None = None) -> list[dict]:
+    """check -> compact -> commit on every replica of dirty volumes."""
+    results = []
+    nodes = await env.list_nodes()
+    # vid -> [(url, msg)]
+    vols: dict[int, list[tuple[str, dict]]] = {}
+    for n in nodes:
+        for m in n["volumes"]:
+            if collection is not None and m["collection"] != collection:
+                continue
+            vols.setdefault(m["id"], []).append((n["url"], m))
+    for vid, holders in sorted(vols.items()):
+        checks = await asyncio.gather(*(
+            env.node_post(url, "/admin/vacuum/check", volume=str(vid))
+            for url, _ in holders), return_exceptions=True)
+        ratios = [c.get("garbage_ratio", 0.0) for c in checks
+                  if isinstance(c, dict)]
+        if not ratios or max(ratios) < garbage_threshold:
+            continue
+        try:
+            await asyncio.gather(*(
+                env.node_post(url, "/admin/vacuum/compact", volume=str(vid))
+                for url, _ in holders))
+            await asyncio.gather(*(
+                env.node_post(url, "/admin/vacuum/commit", volume=str(vid))
+                for url, _ in holders))
+            results.append({"volume": vid, "garbage": max(ratios),
+                            "vacuumed": True})
+        except RuntimeError as e:
+            await asyncio.gather(*(
+                env.node_post(url, "/admin/vacuum/cleanup", volume=str(vid))
+                for url, _ in holders), return_exceptions=True)
+            results.append({"volume": vid, "error": str(e)})
+    return results
+
+
+async def volume_fix_replication(env: CommandEnv,
+                                 apply_changes: bool = True) -> list[dict]:
+    """Re-replicate volumes with fewer live copies than their placement
+    demands (command_volume_fix_replication.go)."""
+    actions = []
+    nodes = await env.list_nodes()
+    by_url = {n["url"]: n for n in nodes}
+    vols: dict[int, list[tuple[str, dict]]] = {}
+    for n in nodes:
+        for m in n["volumes"]:
+            vols.setdefault(m["id"], []).append((n["url"], m))
+    for vid, holders in sorted(vols.items()):
+        msg = holders[0][1]
+        rp = ReplicaPlacement.from_byte(msg["replica_placement"])
+        want, have = rp.copy_count, len(holders)
+        if have >= want:
+            continue
+        holder_urls = {u for u, _ in holders}
+        holder_racks = {(by_url[u]["dataCenter"], by_url[u]["rack"])
+                        for u in holder_urls if u in by_url}
+        # prefer a rack not already holding a replica, then most free slots
+        candidates = sorted(
+            (n for n in nodes
+             if n["url"] not in holder_urls and n["freeSlots"] > 0),
+            key=lambda n: ((n["dataCenter"], n["rack"]) in holder_racks,
+                           -n["freeSlots"]))
+        if not candidates:
+            actions.append({"volume": vid, "error": "no candidate node"})
+            continue
+        target = candidates[0]["url"]
+        actions.append({"volume": vid, "copy_to": target,
+                        "from": holders[0][0]})
+        if apply_changes:
+            await env.node_post(target, "/admin/volume/copy",
+                                volume=str(vid),
+                                collection=msg["collection"],
+                                source=holders[0][0])
+    return actions
+
+
+async def volume_balance(env: CommandEnv,
+                         apply_changes: bool = True) -> list[dict]:
+    """Plan moves from the fullest to the emptiest nodes until counts are
+    within one of each other, then apply (command_volume_balance.go).
+    Planned against one topology snapshot (the master registry lags moves
+    until the next heartbeat)."""
+    snapshot = {n["url"]: {"volumes": {m["id"]: m for m in n["volumes"]},
+                           "free": n["freeSlots"]}
+                for n in await env.list_nodes()}
+    moves: list[dict] = []
+    while len(snapshot) >= 2:
+        ordered = sorted(snapshot.items(), key=lambda kv: len(kv[1]["volumes"]))
+        (low_url, low), (high_url, high) = ordered[0], ordered[-1]
+        if len(high["volumes"]) - len(low["volumes"]) <= 1 or low["free"] <= 0:
+            break
+        movable = [m for vid, m in high["volumes"].items()
+                   if vid not in low["volumes"]]
+        if not movable:
+            break
+        m = movable[0]
+        moves.append({"volume": m["id"], "collection": m["collection"],
+                      "from": high_url, "to": low_url})
+        low["volumes"][m["id"]] = m
+        low["free"] -= 1
+        del high["volumes"][m["id"]]
+        high["free"] += 1
+    if apply_changes:
+        for mv in moves:
+            await volume_move(env, mv["volume"], mv["collection"],
+                              mv["from"], mv["to"])
+    return moves
+
+
+async def volume_move(env: CommandEnv, vid: int, collection: str,
+                      src: str, dst: str) -> None:
+    """copy to dst + mount, then unmount + delete on src
+    (command_volume_move.go)."""
+    await env.node_post(dst, "/admin/volume/copy", volume=str(vid),
+                        collection=collection, source=src)
+    # delete while still mounted so the store destroys the on-disk files
+    # (unmount-then-delete would leave .dat/.idx to resurrect on restart)
+    await env.node_post(src, "/admin/volume/delete", volume=str(vid))

@@ -1,0 +1,69 @@
+"""Prometheus metrics.
+
+Reference: weed/stats/metrics.go:13-92 (per-tier counters/histograms/
+gauges) and :109-137 (push-gateway loop; the master hands the gateway
+address to nodes via heartbeat responses). Exposed here both as a /metrics
+scrape endpoint on every server and an optional push loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+try:
+    from prometheus_client import (CollectorRegistry, Counter, Gauge,
+                                   Histogram, generate_latest,
+                                   push_to_gateway)
+    HAVE_PROMETHEUS = True
+except ImportError:  # pragma: no cover
+    HAVE_PROMETHEUS = False
+
+if HAVE_PROMETHEUS:
+    REGISTRY = CollectorRegistry()
+
+    MASTER_RECEIVED_HEARTBEATS = Counter(
+        "SeaweedFS_master_received_heartbeats", "heartbeats received",
+        registry=REGISTRY)
+    MASTER_ASSIGN_REQUESTS = Counter(
+        "SeaweedFS_master_assign_requests", "assign requests",
+        ["status"], registry=REGISTRY)
+    VOLUME_REQUEST_TIME = Histogram(
+        "SeaweedFS_volumeServer_request_seconds", "needle request time",
+        ["type"], registry=REGISTRY)
+    VOLUME_REQUEST_COUNTER = Counter(
+        "SeaweedFS_volumeServer_request_total", "needle requests",
+        ["type", "status"], registry=REGISTRY)
+    VOLUME_COUNT = Gauge(
+        "SeaweedFS_volumeServer_volumes", "volumes on this server",
+        registry=REGISTRY)
+    FILER_REQUEST_TIME = Histogram(
+        "SeaweedFS_filer_request_seconds", "filer request time",
+        ["type"], registry=REGISTRY)
+    EC_ENCODE_BYTES = Counter(
+        "SeaweedFS_ec_encode_bytes_total", "bytes erasure-encoded",
+        registry=REGISTRY)
+    EC_THROUGHPUT = Gauge(
+        "SeaweedFS_ec_encode_GBps", "last measured EC encode GB/s/chip",
+        registry=REGISTRY)
+
+    def metrics_text() -> bytes:
+        return generate_latest(REGISTRY)
+else:  # pragma: no cover
+    def metrics_text() -> bytes:
+        return b"# prometheus_client unavailable\n"
+
+
+async def push_loop(gateway: str, job: str,
+                    interval_seconds: float = 15.0) -> None:
+    """LoopPushingMetric (metrics.go:109-137)."""
+    if not HAVE_PROMETHEUS or not gateway:
+        return
+    loop = asyncio.get_running_loop()
+    while True:
+        try:
+            await loop.run_in_executor(
+                None, lambda: push_to_gateway(gateway, job=job,
+                                              registry=REGISTRY))
+        except Exception:
+            pass
+        await asyncio.sleep(interval_seconds)

@@ -118,10 +118,32 @@ class Store:
                 v.destroy()
                 self.deleted_volumes.append(msg)
 
-    def mark_readonly(self, vid: int) -> None:
+    def mark_readonly(self, vid: int, read_only: bool = True) -> None:
         with self._lock:
             if vid in self.volumes:
-                self.volumes[vid].read_only = True
+                self.volumes[vid].read_only = read_only
+
+    def mount_volume(self, collection: str, vid: int) -> None:
+        """Load an on-disk volume (after a copy) — VolumeMount."""
+        with self._lock:
+            if vid in self.volumes:
+                return
+            for d in self.dirs:
+                base = os.path.join(
+                    d, f"{collection}_{vid}" if collection else str(vid))
+                if os.path.exists(base + ".dat"):
+                    v = Volume(d, collection, vid, create_if_missing=False)
+                    self.volumes[vid] = v
+                    self.new_volumes.append(self._volume_message(v))
+                    return
+            raise VolumeError(f"volume {vid} not on disk")
+
+    def unmount_volume(self, vid: int) -> None:
+        with self._lock:
+            v = self.volumes.pop(vid, None)
+            if v is not None:
+                self.deleted_volumes.append(self._volume_message(v))
+                v.close()
 
     # ---- data plane ----
 

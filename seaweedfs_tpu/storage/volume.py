@@ -84,6 +84,17 @@ class Volume:
         self.nm = MemoryNeedleMap(base + ".idx")
         self._check_integrity()
 
+    def reload(self) -> None:
+        """Re-open .dat/.idx after an external swap (vacuum commit).
+        Must run under self._lock; keeps the existing lock object so
+        writers already blocked on it serialize correctly."""
+        base = self.file_name()
+        self._dat = open(base + ".dat", "r+b")
+        self.super_block = SuperBlock.from_bytes(self._dat.read(8))
+        self.nm = MemoryNeedleMap(base + ".idx")
+        self.read_only = False
+        self._check_integrity()
+
     # ---- naming ----
 
     def file_name(self) -> str:

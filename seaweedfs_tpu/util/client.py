@@ -20,12 +20,16 @@ class OperationError(Exception):
 class WeedClient:
     def __init__(self, master_url: str,
                  session: aiohttp.ClientSession | None = None,
-                 lookup_cache_ttl: float = 600.0):
+                 lookup_cache_ttl: float = 600.0,
+                 jwt_key: str = ""):
         self.master_url = master_url
         self._session = session
         self._own = session is None
         self._vid_cache: dict[str, tuple[float, list[dict]]] = {}
         self._cache_ttl = lookup_cache_ttl
+        # when the cluster enforces write JWTs, co-deployed components
+        # (filer, chunk GC) mint their own tokens with the shared key
+        self.jwt_key = jwt_key
 
     async def __aenter__(self) -> "WeedClient":
         if self._session is None:
@@ -87,10 +91,20 @@ class WeedClient:
 
     # ---- data ops ----
 
+    def _mint_jwt(self, fid: str) -> str:
+        if not self.jwt_key:
+            return ""
+        from ..security.jwt import gen_jwt
+        return gen_jwt(self.jwt_key, fid)
+
     async def upload(self, fid: str, url: str, data: bytes,
-                     mime: str = "", ttl: str = "") -> dict:
+                     mime: str = "", ttl: str = "",
+                     auth: str = "") -> dict:
         params = {"ttl": ttl} if ttl else {}
         headers = {"Content-Type": mime} if mime else {}
+        token = auth or self._mint_jwt(fid)
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
         async with self.http.post(f"http://{url}/{fid}", data=data,
                                   params=params, headers=headers) as resp:
             body = await resp.json()
@@ -101,10 +115,12 @@ class WeedClient:
     async def upload_data(self, data: bytes, collection: str = "",
                           replication: str = "", ttl: str = "",
                           mime: str = "") -> str:
-        """assign + upload; returns the fid."""
+        """assign + upload (forwarding the assign's write token); returns
+        the fid."""
         a = await self.assign(collection=collection,
                               replication=replication, ttl=ttl)
-        await self.upload(a["fid"], a["url"], data, mime=mime, ttl=ttl)
+        await self.upload(a["fid"], a["url"], data, mime=mime, ttl=ttl,
+                          auth=a.get("auth", ""))
         return a["fid"]
 
     async def read(self, fid: str, offset: int = 0,
@@ -138,10 +154,15 @@ class WeedClient:
         async def drop(server: str, batch: list[str]) -> int:
             n = 0
             for fid in batch:
+                headers = {}
+                token = self._mint_jwt(fid)
+                if token:
+                    headers["Authorization"] = f"Bearer {token}"
                 try:
                     async with self.http.delete(
                             f"http://{server}/{fid}",
-                            params={"type": "replicate"}) as resp:
+                            params={"type": "replicate"},
+                            headers=headers) as resp:
                         n += resp.status == 200
                 except aiohttp.ClientError:
                     pass
