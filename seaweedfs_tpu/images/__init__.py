@@ -1,0 +1,2 @@
+from .resizing import resized  # noqa: F401
+from .orientation import fix_jpeg_orientation  # noqa: F401

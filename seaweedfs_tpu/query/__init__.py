@@ -1,0 +1,1 @@
+from .json_query import Filter, query_json  # noqa: F401

@@ -71,6 +71,7 @@ class VolumeServer:
         app.router.add_post("/admin/ec/delete_shards", self.h_ec_delete_shards)
         app.router.add_get("/admin/ec/shard_read", self.h_ec_shard_read)
         app.router.add_get("/admin/file", self.h_admin_file)
+        app.router.add_post("/admin/query", self.h_query)
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", self.h_metrics)
         # public needle API — catch-all LAST
@@ -231,6 +232,23 @@ class VolumeServer:
             headers["Last-Modified"] = time.strftime(
                 "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
         ct = n.mime.decode() if n.mime else "application/octet-stream"
+        # on-read image resize (volume_server_handlers_read.go:211-227)
+        if ("width" in req.query or "height" in req.query) \
+                and "Content-Encoding" not in headers \
+                and req.method != "HEAD":
+            from ..images import resizing
+            if resizing.resizable(ct):
+                try:
+                    w = int(req.query.get("width", 0) or 0)
+                    h = int(req.query.get("height", 0) or 0)
+                except ValueError:
+                    w = h = 0  # bad params: serve the original (ref parity)
+                mode = req.query.get("mode", "")
+                if w > 0 or h > 0:
+                    body = await loop.run_in_executor(
+                        None,
+                        lambda: resizing.resized(ct, body, w, h, mode))
+                    headers.pop("Etag", None)
         status = 200
         if "Content-Encoding" not in headers:
             # serve byte ranges of the (plain) body so chunked readers
@@ -276,6 +294,11 @@ class VolumeServer:
             data = await req.read()
             if ctype and ctype != "application/octet-stream":
                 mime = ctype.split(";")[0].encode()
+        if mime in (b"image/jpeg", b"image/jpg") or \
+                (name.lower().endswith((b".jpg", b".jpeg")) and not mime):
+            # bake EXIF rotation into stored bytes (needle.go ParseUpload)
+            from ..images import fix_jpeg_orientation
+            data = fix_jpeg_orientation(data)
         n = Needle(cookie=fid.cookie, id=fid.key, data=data, name=name,
                    mime=mime, ttl=t.TTL.parse(req.query.get("ttl", "")),
                    last_modified=int(time.time()))
@@ -693,6 +716,44 @@ class VolumeServer:
                                      status=404)
         return web.Response(body=data,
                             content_type="application/octet-stream")
+
+    async def h_query(self, req: web.Request) -> web.StreamResponse:
+        """Query pushdown (server/volume_grpc_query.go:12-67): stream
+        JSONL of records from the listed fids matching a JSON filter."""
+        from ..query import Filter, query_json
+        from ..query.json_query import OPERANDS
+        body = await req.json()
+        fids = body.get("fromFileIds", body.get("fids", []))
+        flt = Filter.from_dict(body.get("filter"))
+        if flt is not None and flt.operand not in OPERANDS:
+            return web.json_response(
+                {"error": f"unknown operand {flt.operand!r}"}, status=400)
+        selections = body.get("selections") or []
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"})
+        await resp.prepare(req)
+        loop = asyncio.get_running_loop()
+        import json as _json
+
+        def read_and_query(f: t.FileId) -> list[dict]:
+            n = self.store.read_needle(f.volume_id, f.key, f.cookie)
+            data = n.data
+            if n.is_gzipped:
+                data = gzip.decompress(data)
+            return query_json(data, flt, selections)
+
+        for fid_str in fids:
+            try:
+                fid = self._parse_fid(fid_str)
+                recs = await loop.run_in_executor(
+                    None, lambda: read_and_query(fid))
+            except (ValueError, NotFound, AlreadyDeleted, VolumeError,
+                    CrcMismatch, gzip.BadGzipFile, OSError):
+                continue
+            for rec in recs:
+                await resp.write(_json.dumps(rec).encode() + b"\n")
+        await resp.write_eof()
+        return resp
 
     async def h_admin_file(self, req: web.Request) -> web.Response:
         """Stream a raw volume/shard file (CopyFile analog for ec.copy)."""

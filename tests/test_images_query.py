@@ -1,0 +1,143 @@
+"""Image resize on read + EXIF fix on upload + JSON query pushdown.
+
+Reference behaviors: weed/images/resizing.go + orientation.go (hooked at
+volume_server_handlers_read.go:211-227 / needle.go ParseUpload) and
+weed/query/json/query_json.go + server/volume_grpc_query.go.
+"""
+
+import io
+import json
+
+import pytest
+
+from cluster_util import Cluster, run
+
+from seaweedfs_tpu.images import fix_jpeg_orientation, resizing
+from seaweedfs_tpu.query import Filter, query_json
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+def _png(w, h, color=(255, 0, 0)):
+    img = Image.new("RGB", (w, h), color)
+    out = io.BytesIO()
+    img.save(out, format="PNG")
+    return out.getvalue()
+
+
+# ---- pure-function tests ----
+
+def test_resized_default_stretches_fit_proportional():
+    data = _png(100, 50)
+    # default mode stretches to the exact box (resizing.go imaging.Resize)
+    img = Image.open(io.BytesIO(resizing.resized("image/png", data, 50, 50)))
+    assert img.size == (50, 50)
+    # fit is proportional within the box
+    img = Image.open(io.BytesIO(
+        resizing.resized("image/png", data, 50, 50, mode="fit")))
+    assert img.size == (50, 25)
+
+
+def test_resized_fill_crops():
+    data = _png(100, 50)
+    out = resizing.resized("image/png", data, 40, 40, mode="fill")
+    img = Image.open(io.BytesIO(out))
+    assert img.size == (40, 40)
+
+
+def test_resized_single_dimension_and_noop():
+    data = _png(100, 50)
+    img = Image.open(io.BytesIO(resizing.resized("image/png", data, 50, 0)))
+    assert img.size == (50, 25)
+    # already small enough -> unchanged bytes
+    assert resizing.resized("image/png", data, 200, 200) == data
+    # non-image mime -> unchanged
+    assert resizing.resized("text/plain", b"hello", 10, 10) == b"hello"
+
+
+def test_fix_jpeg_orientation():
+    img = Image.new("RGB", (40, 20), (0, 128, 255))
+    out = io.BytesIO()
+    exif = Image.Exif()
+    exif[0x0112] = 6  # rotate 90 CW to display upright
+    img.save(out, format="JPEG", exif=exif)
+    fixed = fix_jpeg_orientation(out.getvalue())
+    fimg = Image.open(io.BytesIO(fixed))
+    assert fimg.size == (20, 40)  # rotated
+    assert fimg.getexif().get(0x0112, 1) == 1
+    # non-jpeg passes through
+    png = _png(4, 4)
+    assert fix_jpeg_orientation(png) == png
+
+
+def test_query_json_filter_and_projection():
+    data = b"\n".join(json.dumps(r).encode() for r in [
+        {"name": "a", "age": 30, "addr": {"city": "sf"}},
+        {"name": "b", "age": 10, "addr": {"city": "nyc"}},
+        {"name": "c", "age": 25, "addr": {"city": "sf"}},
+    ])
+    got = query_json(data, Filter("age", ">", "20"), ["name", "addr.city"])
+    assert got == [{"name": "a", "addr.city": "sf"},
+                   {"name": "c", "addr.city": "sf"}]
+    # string equality + like
+    got = query_json(data, Filter("addr.city", "=", "nyc"), ["name"])
+    assert got == [{"name": "b"}]
+    got = query_json(data, Filter("name", "like", "a"), None)
+    assert got[0]["age"] == 30
+    # whole-body JSON array form
+    arr = json.dumps([{"x": 1}, {"x": 2}]).encode()
+    assert query_json(arr, Filter("x", ">=", "2"), ["x"]) == [{"x": 2}]
+
+
+# ---- server integration ----
+
+def test_volume_server_resize_and_query(tmp_path):
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            # image upload + resized read
+            a = await c.assign()
+            png = _png(64, 32)
+            async with c.http.post(
+                    f"http://{a['url']}/{a['fid']}", data=png,
+                    headers={"Content-Type": "image/png"}) as resp:
+                assert resp.status == 201
+            async with c.http.get(
+                    f"http://{a['publicUrl']}/{a['fid']}",
+                    params={"width": "32", "height": "32",
+                            "mode": "fit"}) as resp:
+                assert resp.status == 200
+                img = Image.open(io.BytesIO(await resp.read()))
+                assert img.size == (32, 16)
+            # bad width param: serve original, not 500
+            async with c.http.get(
+                    f"http://{a['publicUrl']}/{a['fid']}",
+                    params={"width": "abc"}) as resp:
+                assert resp.status == 200
+                assert await resp.read() == png
+            # unknown query operand: clean 400
+            async with c.http.post(
+                    f"http://{a['url']}/admin/query",
+                    json={"fromFileIds": [a["fid"]],
+                          "filter": {"field": "x", "operand": "~",
+                                     "value": "1"}}) as resp:
+                assert resp.status == 400
+
+            # JSON records + query pushdown
+            recs = [{"user": "u1", "n": i} for i in range(5)]
+            a2 = await c.assign()
+            body_json = "\n".join(json.dumps(r) for r in recs).encode()
+            async with c.http.post(
+                    f"http://{a2['url']}/{a2['fid']}", data=body_json,
+                    headers={"Content-Type": "application/json"}) as resp:
+                assert resp.status == 201
+            q = {"fromFileIds": [a2["fid"]],
+                 "filter": {"field": "n", "operand": ">=", "value": "3"},
+                 "selections": ["n"]}
+            async with c.http.post(
+                    f"http://{a2['url']}/admin/query", json=q) as resp:
+                assert resp.status == 200
+                lines = [json.loads(x) for x in
+                         (await resp.text()).strip().splitlines()]
+            assert lines == [{"n": 3}, {"n": 4}]
+    run(body())
