@@ -59,6 +59,10 @@ class VolumeServer:
         app.router.add_post("/admin/volume/mount", self.h_volume_mount)
         app.router.add_post("/admin/volume/unmount", self.h_volume_unmount)
         app.router.add_post("/admin/volume/copy", self.h_volume_copy)
+        app.router.add_get("/admin/volume/status", self.h_volume_status)
+        app.router.add_get("/admin/volume/tail", self.h_volume_tail)
+        app.router.add_post("/admin/volume/tail_receive",
+                            self.h_volume_tail_receive)
         app.router.add_post("/admin/vacuum/check", self.h_vacuum_check)
         app.router.add_post("/admin/vacuum/compact", self.h_vacuum_compact)
         app.router.add_post("/admin/vacuum/commit", self.h_vacuum_commit)
@@ -552,6 +556,87 @@ class VolumeServer:
                     os.remove(base + ext)
             return web.json_response({"error": err}, status=502)
         return await self.h_volume_mount(req)
+
+    # ---- incremental backup / tail (volume_backup.go) ----
+
+    async def h_volume_status(self, req: web.Request) -> web.Response:
+        """Per-volume sync metadata (VolumeSyncStatus RPC analog)."""
+        vid = int(req.query["volume"])
+        v = self.store.volumes.get(vid)
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response({
+            "volume": vid,
+            "collection": v.collection,
+            "last_append_at_ns": v.last_append_at_ns,
+            "compaction_revision": v.super_block.compaction_revision,
+            "replication": str(v.super_block.replica_placement),
+            "ttl": str(v.ttl),
+            "data_size": v.data_size(),
+        })
+
+    async def h_volume_tail(self, req: web.Request) -> web.StreamResponse:
+        """VolumeTailSender (volume_server.proto:47-50): stream framed
+        needle records appended after since_ns."""
+        from ..storage import volume_backup as vb
+        vid = int(req.query["volume"])
+        since_ns = int(req.query.get("since_ns", 0))
+        v = self.store.volumes.get(vid)
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        resp = web.StreamResponse(
+            headers={"Content-Type": "application/octet-stream"})
+        await resp.prepare(req)
+        loop = asyncio.get_running_loop()
+        # stream record-by-record: each iteration does one short locked
+        # read in the executor, so large tails neither hold the volume
+        # lock across awaits nor buffer the whole tail in RAM
+        it = vb.tail_records(v, since_ns)
+        while True:
+            item = await loop.run_in_executor(
+                None, lambda: next(it, None))
+            if item is None:
+                break
+            n, is_delete = item
+            await resp.write(vb.frame_needle(n, is_delete))
+        await resp.write_eof()
+        return resp
+
+    async def h_volume_tail_receive(self, req: web.Request) -> web.Response:
+        """VolumeTailReceiver: pull a source volume's tail into the local
+        copy (used by replica catch-up)."""
+        from ..storage import volume_backup as vb
+        q = req.query
+        vid = int(q["volume"])
+        source = q["source"]
+        v = self.store.volumes.get(vid)
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        since = v.last_append_at_ns
+        applied = 0
+        try:
+            async with self._http.get(
+                    f"http://{source}/admin/volume/tail",
+                    params={"volume": str(vid),
+                            "since_ns": str(since)}) as resp:
+                if resp.status != 200:
+                    return web.json_response(
+                        {"error": f"tail from {source}: {resp.status}"},
+                        status=502)
+                body = await resp.read()
+        except aiohttp.ClientError as e:
+            return web.json_response({"error": str(e)}, status=502)
+        loop = asyncio.get_running_loop()
+
+        def apply_all() -> int:
+            count = 0
+            for n, is_delete in vb.iter_frames([body]):
+                vb.apply_needle(v, n, is_delete)
+                count += 1
+            return count
+
+        applied = await loop.run_in_executor(None, apply_all)
+        return web.json_response({"applied": applied})
 
     # ---- vacuum (volume_vacuum.go + topology_vacuum.go protocol) ----
 

@@ -134,6 +134,9 @@ class Volume:
             raise VolumeError(
                 f"volume {self.vid}: tail needle key mismatch "
                 f"{n.id:x} != {key:x}")
+        # restore the incremental-sync watermark (volume_backup.go relies
+        # on lastAppendAtNs surviving restarts)
+        self.last_append_at_ns = n.append_at_ns
         if expected_end < size:
             # torn write past the last logged record: truncate it away
             self._dat.truncate(expected_end)
