@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-rack", default="")
     v.add_argument("-pulseSeconds", type=float, default=5.0)
     v.add_argument("-jwtKey", default="")
+    v.add_argument("-tierS3Endpoint", default="",
+                   help="S3-compatible endpoint for volume.tier.upload "
+                        "(configures backend id s3.default)")
+    v.add_argument("-tierS3Bucket", default="volume-tier")
 
     f = sub.add_parser("filer", help="start a filer server")
     _add_common(f)
@@ -155,6 +159,11 @@ async def _run_volume(args) -> None:
     maxes = [int(x) for x in args.max.split(",")]
     if len(maxes) == 1:
         maxes = maxes * len(dirs)
+    if args.tierS3Endpoint:
+        from .storage.backend import load_backends
+        load_backends({"s3": {"default": {
+            "endpoint": args.tierS3Endpoint,
+            "bucket": args.tierS3Bucket}}})
     store = Store(dirs, max_volume_counts=maxes)
     vs = VolumeServer(store, args.master, ip=args.ip, port=args.port,
                       data_center=args.dataCenter, rack=args.rack,
@@ -360,25 +369,42 @@ async def _run_backup(args) -> None:
             v.close()
             # .idx before .dat (see h_volume_copy): a racing write then at
             # most leaves extra .dat tail past the last copied idx entry,
-            # which the open-time integrity check truncates
-            for ext in (".idx", ".dat"):
-                async with http.get(
-                        f"http://{args.server}/admin/file",
-                        params={"volume": str(args.volumeId),
-                                "collection": collection,
-                                "ext": ext}) as resp:
-                    if resp.status != 200:
-                        print(f"fetch {ext}: http {resp.status}")
-                        sys.exit(1)
-                    with open(base + ext, "wb") as f:
-                        async for chunk in resp.content.iter_chunked(1 << 20):
-                            f.write(chunk)
+            # which the open-time integrity check truncates. Download to
+            # .tmp and swap both only on success so a mid-fetch failure
+            # leaves the previous backup intact.
+            tmps: list[tuple[str, str]] = []
+            try:
+                for ext in (".idx", ".dat"):
+                    tmp = base + ext + ".tmp"
+                    async with http.get(
+                            f"http://{args.server}/admin/file",
+                            params={"volume": str(args.volumeId),
+                                    "collection": collection,
+                                    "ext": ext}) as resp:
+                        if resp.status != 200:
+                            raise RuntimeError(
+                                f"fetch {ext}: http {resp.status}")
+                        with open(tmp, "wb") as f:
+                            async for chunk in \
+                                    resp.content.iter_chunked(1 << 20):
+                                f.write(chunk)
+                    tmps.append((tmp, base + ext))
+            except (RuntimeError, aiohttp.ClientError, OSError) as e:
+                for tmp, _ in tmps:
+                    if os.path.exists(tmp):
+                        os.remove(tmp)
+                print(f"full copy failed: {e}")
+                sys.exit(1)
+            for tmp, final in tmps:
+                os.replace(tmp, final)
             v = Volume(args.dir, collection, args.volumeId,
                        create_if_missing=False)
             print(f"full copy of volume {args.volumeId}: "
                   f"{v.data_size()} bytes")
         else:
             since = v.last_append_at_ns
+            applied = 0
+            dec = vb.FrameDecoder()
             async with http.get(
                     f"http://{args.server}/admin/volume/tail",
                     params={"volume": str(args.volumeId),
@@ -386,11 +412,10 @@ async def _run_backup(args) -> None:
                 if resp.status != 200:
                     print(f"tail from {args.server}: http {resp.status}")
                     sys.exit(1)
-                body = await resp.read()
-            applied = 0
-            for n, is_delete in vb.iter_frames([body]):
-                vb.apply_needle(v, n, is_delete)
-                applied += 1
+                async for chunk in resp.content.iter_chunked(1 << 20):
+                    for n, is_delete in dec.feed(chunk):
+                        vb.apply_needle(v, n, is_delete)
+                        applied += 1
             print(f"applied {applied} records to volume {args.volumeId} "
                   f"(since_ns={since})")
         v.close()

@@ -76,6 +76,8 @@ class VolumeServer:
         app.router.add_get("/admin/ec/shard_read", self.h_ec_shard_read)
         app.router.add_get("/admin/file", self.h_admin_file)
         app.router.add_post("/admin/query", self.h_query)
+        app.router.add_post("/admin/tier/upload", self.h_tier_upload)
+        app.router.add_post("/admin/tier/download", self.h_tier_download)
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", self.h_metrics)
         # public needle API — catch-all LAST
@@ -614,6 +616,14 @@ class VolumeServer:
             return web.json_response({"error": "not found"}, status=404)
         since = v.last_append_at_ns
         applied = 0
+        loop = asyncio.get_running_loop()
+        dec = vb.FrameDecoder()
+
+        def apply_batch(recs) -> int:
+            for n, is_delete in recs:
+                vb.apply_needle(v, n, is_delete)
+            return len(recs)
+
         try:
             async with self._http.get(
                     f"http://{source}/admin/volume/tail",
@@ -623,20 +633,53 @@ class VolumeServer:
                     return web.json_response(
                         {"error": f"tail from {source}: {resp.status}"},
                         status=502)
-                body = await resp.read()
+                # apply as chunks arrive — no whole-tail buffering
+                async for chunk in resp.content.iter_chunked(1 << 20):
+                    recs = dec.feed(chunk)
+                    if recs:
+                        applied += await loop.run_in_executor(
+                            None, lambda: apply_batch(recs))
         except aiohttp.ClientError as e:
             return web.json_response({"error": str(e)}, status=502)
-        loop = asyncio.get_running_loop()
-
-        def apply_all() -> int:
-            count = 0
-            for n, is_delete in vb.iter_frames([body]):
-                vb.apply_needle(v, n, is_delete)
-                count += 1
-            return count
-
-        applied = await loop.run_in_executor(None, apply_all)
         return web.json_response({"applied": applied})
+
+    # ---- tiered storage (volume_grpc_tier_upload.go/_download.go) ----
+
+    async def h_tier_upload(self, req: web.Request) -> web.Response:
+        """VolumeTierMoveDatToRemote: ship .dat to a configured backend."""
+        from ..storage import volume_tier
+        from ..storage.backend import BackendError
+        q = req.query
+        vid = int(q["volume"])
+        backend_id = q.get("backend", "s3.default")
+        keep_local = q.get("keep_local", "") == "1"
+        v = self.store.volumes.get(vid)
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        loop = asyncio.get_running_loop()
+        try:
+            size = await loop.run_in_executor(
+                None, lambda: volume_tier.tier_upload(
+                    v, backend_id, keep_local))
+        except (BackendError, VolumeError) as e:
+            return web.json_response({"error": str(e)}, status=502)
+        return web.json_response({"uploaded": size, "backend": backend_id})
+
+    async def h_tier_download(self, req: web.Request) -> web.Response:
+        """VolumeTierMoveDatFromRemote: bring the .dat back to disk."""
+        from ..storage import volume_tier
+        from ..storage.backend import BackendError
+        vid = int(req.query["volume"])
+        v = self.store.volumes.get(vid)
+        if v is None:
+            return web.json_response({"error": "not found"}, status=404)
+        loop = asyncio.get_running_loop()
+        try:
+            size = await loop.run_in_executor(
+                None, lambda: volume_tier.tier_download(v))
+        except (BackendError, VolumeError) as e:
+            return web.json_response({"error": str(e)}, status=502)
+        return web.json_response({"downloaded": size})
 
     # ---- vacuum (volume_vacuum.go + topology_vacuum.go protocol) ----
 

@@ -20,6 +20,8 @@ HELP = """commands:
   volume.fix.replication [-force]
   volume.balance         [-force]
   volume.move  -volumeId n -source host:port -target host:port
+  volume.tier.upload   -volumeId n [-backend s3.default] [-keepLocal]
+  volume.tier.download -volumeId n
   volume.list
 """
 
@@ -75,6 +77,13 @@ async def run_command(master_url: str, line: str) -> object:
                                  flags.get("collection", ""),
                                  flags["source"], flags["target"])
             res = {"moved": flags["volumeId"]}
+        elif cmd == "volume.tier.upload":
+            res = await vc.volume_tier_upload(
+                env, int(flags["volumeId"]),
+                backend=flags.get("backend", "s3.default"),
+                keep_local=flags.get("keepLocal") == "true")
+        elif cmd == "volume.tier.download":
+            res = await vc.volume_tier_download(env, int(flags["volumeId"]))
         elif cmd == "volume.list":
             res = await env.list_nodes()
         else:

@@ -134,3 +134,32 @@ async def volume_move(env: CommandEnv, vid: int, collection: str,
     # delete while still mounted so the store destroys the on-disk files
     # (unmount-then-delete would leave .dat/.idx to resurrect on restart)
     await env.node_post(src, "/admin/volume/delete", volume=str(vid))
+
+
+async def volume_tier_upload(env: CommandEnv, vid: int,
+                             backend: str = "s3.default",
+                             keep_local: bool = False) -> dict:
+    """Ship a volume's .dat to remote storage
+    (shell/command_volume_tier_upload.go)."""
+    locs = await env.master_get("/dir/lookup", volumeId=str(vid))
+    if "locations" not in locs:
+        raise ValueError(f"volume {vid} not found")
+    out = {}
+    for loc in locs["locations"]:
+        out[loc["url"]] = await env.node_post(
+            loc["url"], "/admin/tier/upload", volume=str(vid),
+            backend=backend, keep_local="1" if keep_local else "")
+    return out
+
+
+async def volume_tier_download(env: CommandEnv, vid: int) -> dict:
+    """Bring a tiered volume's .dat back to local disk
+    (shell/command_volume_tier_download.go)."""
+    locs = await env.master_get("/dir/lookup", volumeId=str(vid))
+    if "locations" not in locs:
+        raise ValueError(f"volume {vid} not found")
+    out = {}
+    for loc in locs["locations"]:
+        out[loc["url"]] = await env.node_post(
+            loc["url"], "/admin/tier/download", volume=str(vid))
+    return out

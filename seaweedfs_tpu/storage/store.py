@@ -67,6 +67,21 @@ class Store:
                                            create_if_missing=False)
             except VolumeError:
                 continue
+        for path in glob.glob(os.path.join(d, "*.vif")):
+            # tiered volume: .dat lives on a remote backend (.vif sidecar)
+            m = _VOL_RE.match(os.path.basename(path)[:-4] + ".dat")
+            if not m:
+                continue
+            vid = int(m.group("vid"))
+            if vid in self.volumes:
+                continue
+            col = m.group("col") or ""
+            try:
+                self.volumes[vid] = Volume(d, col, vid,
+                                           create_if_missing=False)
+            except Exception:
+                # backend unreachable or not configured yet: skip
+                continue
         for path in glob.glob(os.path.join(d, "*.ecx")):
             m = _EC_RE.match(os.path.basename(path))
             if not m:

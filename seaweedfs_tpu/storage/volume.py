@@ -59,6 +59,35 @@ class Volume:
         base = self.file_name()
         dat_path = base + ".dat"
         exists = os.path.exists(dat_path)
+        self.is_remote = False
+
+        if not exists:
+            # tiered volume? .vif sidecar says which backend holds .dat
+            # (volume_tier.go LoadVolumeTierInfo)
+            from . import backend as _backend
+            vinfo = _backend.load_volume_info(base)
+            if vinfo and vinfo.get("files"):
+                fi = vinfo["files"][0]
+                bs = _backend.get_backend(fi["backend_id"])
+                self._dat = _backend.RemoteDatFile(
+                    bs.new_storage_file(fi["key"],
+                                        fi.get("file_size", -1)))
+                self._dat.seek(0)
+                self.super_block = SuperBlock.from_bytes(self._dat.read(8))
+                self.is_remote = True
+                self.read_only = True
+                self.nm = MemoryNeedleMap(base + ".idx")
+                last = self.nm.last_entry
+                if last is not None and last[1] > 0:
+                    try:
+                        n = self._read_at(
+                            last[1],
+                            0 if last[2] == t.TOMBSTONE_FILE_SIZE
+                            else last[2])
+                        self.last_append_at_ns = n.append_at_ns
+                    except NeedleError:
+                        pass
+                return
         if not exists and not create_if_missing:
             raise VolumeError(f"volume file missing: {dat_path}")
 
@@ -68,6 +97,12 @@ class Volume:
             if len(sb_raw) < 8:
                 raise VolumeError(f"corrupt superblock in {dat_path}")
             self.super_block = SuperBlock.from_bytes(sb_raw)
+            from . import backend as _backend
+            if _backend.load_volume_info(base) is not None:
+                # tiered with -keepLocal: serve reads from the local copy
+                # but stay sealed — new writes would silently diverge
+                # from the remote object recorded in the .vif
+                self.read_only = True
         else:
             os.makedirs(dirname, exist_ok=True)
             self.super_block = SuperBlock(
@@ -268,7 +303,21 @@ class Volume:
         with self._lock:
             self.nm.destroy()
             self._dat.close()
-            for ext in (".dat",):
-                p = self.file_name() + ext
+            base = self.file_name()
+            if self.is_remote:
+                # drop the remote object too, or the .vif-less leftovers
+                # would orphan it (and the .vif would resurrect an empty
+                # volume on restart)
+                from . import backend as _backend
+                vinfo = _backend.load_volume_info(base)
+                if vinfo and vinfo.get("files"):
+                    fi = vinfo["files"][0]
+                    try:
+                        _backend.get_backend(fi["backend_id"]).delete_file(
+                            fi["key"])
+                    except _backend.BackendError:
+                        pass
+            for ext in (".dat", ".vif"):
+                p = base + ext
                 if os.path.exists(p):
                     os.remove(p)

@@ -78,16 +78,18 @@ def tail_records(v: Volume, since_ns: int) -> Iterator[tuple[Needle, bool]]:
     disambiguates tombstones from legitimate zero-byte file writes.
     """
     with v._lock:
+        revision = v.super_block.compaction_revision
         entries = [(k, o, s) for (k, o, s) in _idx_entries(v) if o > 0]
         start = _first_entry_after(v, since_ns, entries)
     for key, offset, size in entries[start:]:
         is_delete = size == t.TOMBSTONE_FILE_SIZE
+        body_size = 0 if is_delete else size
         with v._lock:
-            v._dat.seek(offset)
-            header = v._dat.read(t.NEEDLE_HEADER_SIZE)
-            if len(header) < t.NEEDLE_HEADER_SIZE:
+            if v.super_block.compaction_revision != revision:
+                # vacuum commit swapped .dat under us: the snapshot
+                # offsets are stale — abort; the receiver retries from
+                # its watermark against the compacted file
                 return
-            body_size = int.from_bytes(header[12:16], "big")
             v._dat.seek(offset)
             blob = v._dat.read(t.actual_size(body_size, v.version))
         n = Needle.from_bytes(blob, v.version, check_crc=False)
@@ -126,20 +128,34 @@ def frame_needle(n: Needle, is_delete: bool = False) -> bytes:
         len(blob).to_bytes(4, "big") + blob
 
 
+class FrameDecoder:
+    """Incremental decoder for frame_needle() streams; feed() chunks of
+    arbitrary size, get back completed records. Lets async receivers
+    apply records as they arrive instead of buffering whole tails."""
+
+    def __init__(self) -> None:
+        self._buf = bytearray()
+
+    def feed(self, chunk: bytes) -> list[tuple[Needle, bool]]:
+        self._buf += chunk
+        out: list[tuple[Needle, bool]] = []
+        while True:
+            if len(self._buf) < 5:
+                break
+            is_delete = self._buf[0] != 0
+            ln = int.from_bytes(self._buf[1:5], "big")
+            if len(self._buf) < 5 + ln:
+                break
+            blob = bytes(self._buf[5:5 + ln])
+            del self._buf[:5 + ln]
+            out.append((Needle.from_bytes(blob, t.VERSION3,
+                                          check_crc=False), is_delete))
+        return out
+
+
 def iter_frames(data_iter) -> Iterator[tuple[Needle, bool]]:
     """Decode a stream of frame_needle()-framed records from a byte
     iterator (chunks of arbitrary size)."""
-    buf = bytearray()
+    dec = FrameDecoder()
     for chunk in data_iter:
-        buf += chunk
-        while True:
-            if len(buf) < 5:
-                break
-            is_delete = buf[0] != 0
-            ln = int.from_bytes(buf[1:5], "big")
-            if len(buf) < 5 + ln:
-                break
-            blob = bytes(buf[5:5 + ln])
-            del buf[:5 + ln]
-            yield Needle.from_bytes(blob, t.VERSION3,
-                                    check_crc=False), is_delete
+        yield from dec.feed(chunk)

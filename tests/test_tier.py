@@ -1,0 +1,161 @@
+"""Tiered storage: move sealed .dat to an S3-compatible remote; reads
+flow through ranged GETs transparently.
+
+Reference: weed/storage/backend/ (BackendStorage abstraction,
+s3_backend.go ReadAt-over-ranged-GET), weed/storage/volume_tier.go,
+server/volume_grpc_tier_upload.go/_download.go. The remote here is this
+package's own S3 gateway — dogfooding the gateway as the object tier.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from cluster_util import Cluster, run
+
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.s3.gateway import S3Gateway
+from seaweedfs_tpu.storage import backend as bk
+from seaweedfs_tpu.storage import volume_tier
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume, VolumeError
+
+
+@pytest.fixture(autouse=True)
+def _clean_backends():
+    bk.clear_backends()
+    yield
+    bk.clear_backends()
+
+
+def test_tier_upload_read_download(tmp_path):
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            # stand up an S3 gateway on the same cluster as the tier target
+            s3 = S3Gateway(Filer("memory"), c.master.url, port=0)
+            await s3.start()
+            try:
+                bk.load_backends({"s3": {"default": {
+                    "endpoint": s3.url, "bucket": "tier"}}})
+                # write some needles
+                a = await c.assign()
+                fids = [a["fid"]]
+                st, _ = await c.put(a["fid"], a["url"], b"tiered-0")
+                assert st == 201
+                vid = a["fid"].split(",")[0]
+                for i in range(1, 4):
+                    f2 = f"{vid},{i+1:02x}deadbeef"
+                    st, _ = await c.put(f2, a["url"], f"tiered-{i}".encode())
+                    assert st == 201
+                    fids.append(f2)
+
+                # upload the volume's .dat to the s3 tier
+                async with c.http.post(
+                        f"http://{a['url']}/admin/tier/upload",
+                        params={"volume": vid,
+                                "backend": "s3.default"}) as resp:
+                    body_ = await resp.json()
+                    assert resp.status == 200, body_
+                    assert body_["uploaded"] > 0
+                vs = c.servers[0]
+                v = vs.store.volumes[int(vid)]
+                assert v.is_remote
+                base = v.file_name()
+                assert not os.path.exists(base + ".dat")  # moved away
+                assert os.path.exists(base + ".vif")
+
+                # reads now go through ranged GETs against the gateway
+                for i, fid in enumerate(fids):
+                    stc, data = await c.get(fid, a["publicUrl"])
+                    assert stc == 200 and data == f"tiered-{i}".encode()
+
+                # writes are rejected: volume is sealed
+                st, _ = await c.put(f"{vid},77feedface", a["url"], b"nope")
+                assert st in (409, 500)
+
+                # bring it back down
+                async with c.http.post(
+                        f"http://{a['url']}/admin/tier/download",
+                        params={"volume": vid}) as resp:
+                    body_ = await resp.json()
+                    assert resp.status == 200, body_
+                assert not v.is_remote
+                assert os.path.exists(base + ".dat")
+                assert not os.path.exists(base + ".vif")
+                stc, data = await c.get(fids[2], a["publicUrl"])
+                assert stc == 200 and data == b"tiered-2"
+            finally:
+                await s3.stop()
+    run(body())
+
+
+def test_remote_volume_reload_from_vif(tmp_path):
+    """A store restart re-opens tiered volumes from .idx + .vif alone."""
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            s3 = S3Gateway(Filer("memory"), c.master.url, port=0)
+            await s3.start()
+            try:
+                bk.load_backends({"s3": {"default": {
+                    "endpoint": s3.url, "bucket": "tier2"}}})
+                vdir = str(tmp_path / "offline")
+
+                # sync volume I/O does blocking HTTP to the in-loop
+                # gateway, so it must run off the event loop
+                def offline_work() -> None:
+                    v = Volume(vdir, "", 9)
+                    v.write_needle(
+                        Needle(cookie=1, id=5, data=b"persisted"))
+                    volume_tier.tier_upload(v, "s3.default")
+                    v.close()
+                    # reopen purely from .idx/.vif
+                    v2 = Volume(vdir, "", 9, create_if_missing=False)
+                    assert v2.is_remote
+                    assert v2.read_needle(5).data == b"persisted"
+                    with pytest.raises(VolumeError):
+                        v2.write_needle(Needle(cookie=1, id=6, data=b"x"))
+                    v2.close()
+
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, offline_work)
+            finally:
+                await s3.stop()
+    run(body())
+
+
+def test_keep_local_stays_sealed_after_reopen(tmp_path):
+    """tier.upload -keepLocal keeps the local .dat, but a restart must not
+    resurrect the volume as writable (it would diverge from the remote)."""
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            s3 = S3Gateway(Filer("memory"), c.master.url, port=0)
+            await s3.start()
+            try:
+                bk.load_backends({"s3": {"default": {
+                    "endpoint": s3.url, "bucket": "tier3"}}})
+                vdir = str(tmp_path / "keep")
+
+                def work():
+                    v = Volume(vdir, "", 3)
+                    v.write_needle(Needle(cookie=9, id=1, data=b"kept"))
+                    volume_tier.tier_upload(v, "s3.default",
+                                            keep_local=True)
+                    v.close()
+                    assert os.path.exists(os.path.join(vdir, "3.dat"))
+                    v2 = Volume(vdir, "", 3, create_if_missing=False)
+                    assert v2.read_only  # sealed via .vif presence
+                    assert v2.read_needle(1).data == b"kept"
+                    with pytest.raises(VolumeError):
+                        v2.write_needle(Needle(cookie=9, id=2, data=b"x"))
+                    # download restores writability and drops the .vif
+                    volume_tier.tier_download(v2)
+                    assert not v2.read_only
+                    v2.write_needle(Needle(cookie=9, id=2, data=b"y"))
+                    v2.close()
+
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, work)
+            finally:
+                await s3.stop()
+    run(body())
