@@ -65,6 +65,16 @@ def build_parser() -> argparse.ArgumentParser:
     s3p.add_argument("-store", default="sqlite")
     s3p.add_argument("-dbPath", default="./s3filer.db")
 
+    wd = sub.add_parser("webdav", help="start a WebDAV gateway")
+    _add_common(wd)
+    wd.add_argument("-port", type=int, default=7333)
+    wd.add_argument("-store", default="sqlite",
+                    help="filer store: memory|sqlite")
+    wd.add_argument("-dbPath", default="./webdav.db")
+    wd.add_argument("-collection", default="")
+    wd.add_argument("-replication", default="")
+    wd.add_argument("-chunkSizeMB", type=int, default=16)
+
     srv = sub.add_parser("server",
                          help="combined master+volume+filer+s3 in one process")
     _add_common(srv)
@@ -195,6 +205,20 @@ async def _run_s3(args) -> None:
                    ip=args.ip, port=args.port)
     await s3.start()
     print(f"s3 gateway listening on {s3.url}")
+    await asyncio.Event().wait()
+
+
+async def _run_webdav(args) -> None:
+    from .filer.filer import Filer
+    from .server.webdav_server import WebDavServer
+    kwargs = {"path": args.dbPath} if args.store == "sqlite" else {}
+    wd = WebDavServer(Filer(args.store, **kwargs), args.master,
+                      ip=args.ip, port=args.port,
+                      collection=args.collection,
+                      replication=args.replication,
+                      chunk_size=args.chunkSizeMB * 1024 * 1024)
+    await wd.start()
+    print(f"webdav listening on {wd.url} (store={args.store})")
     await asyncio.Event().wait()
 
 
@@ -395,7 +419,10 @@ async def _run_backup(args) -> None:
                         os.remove(tmp)
                 print(f"full copy failed: {e}")
                 sys.exit(1)
-            for tmp, final in tmps:
+            # swap .dat before .idx: a crash in between leaves old .idx +
+            # new (superset) .dat, which the open-time integrity check
+            # truncates to a consistent state; the reverse order is fatal
+            for tmp, final in reversed(tmps):
                 os.replace(tmp, final)
             v = Volume(args.dir, collection, args.volumeId,
                        create_if_missing=False)
@@ -531,6 +558,7 @@ def main(argv: list[str] | None = None) -> None:
         "s3": _run_s3, "server": _run_server, "upload": _run_upload,
         "download": _run_download, "shell": _run_shell,
         "benchmark": _run_benchmark, "backup": _run_backup,
+        "webdav": _run_webdav,
     }
     try:
         asyncio.run(runners[args.cmd](args))

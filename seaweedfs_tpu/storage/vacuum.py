@@ -31,6 +31,10 @@ def compact(v: Volume) -> None:
     Compact2 strategy, volume_vacuum.go:59-77). Leaves originals alive for
     concurrent traffic; remembers the watermark for makeup_diff."""
     base = v.file_name()
+    from . import backend as _backend
+    if v.is_remote or _backend.load_volume_info(base) is not None:
+        raise VacuumError(
+            f"volume {v.vid} is tiered; tier.download before compacting")
     v.last_compact_index_offset = v.nm.index_file_size()
     v.last_compact_revision = v.super_block.compaction_revision
     now = time.time()

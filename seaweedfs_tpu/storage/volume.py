@@ -127,7 +127,10 @@ class Volume:
         self._dat = open(base + ".dat", "r+b")
         self.super_block = SuperBlock.from_bytes(self._dat.read(8))
         self.nm = MemoryNeedleMap(base + ".idx")
-        self.read_only = False
+        from . import backend as _backend
+        # a .vif means the volume is tiered (keep_local): stay sealed so
+        # local writes can't diverge from the remote object
+        self.read_only = _backend.load_volume_info(base) is not None
         self._check_integrity()
 
     # ---- naming ----
@@ -304,19 +307,20 @@ class Volume:
             self.nm.destroy()
             self._dat.close()
             base = self.file_name()
-            if self.is_remote:
-                # drop the remote object too, or the .vif-less leftovers
-                # would orphan it (and the .vif would resurrect an empty
-                # volume on restart)
-                from . import backend as _backend
-                vinfo = _backend.load_volume_info(base)
-                if vinfo and vinfo.get("files"):
-                    fi = vinfo["files"][0]
-                    try:
-                        _backend.get_backend(fi["backend_id"]).delete_file(
-                            fi["key"])
-                    except _backend.BackendError:
-                        pass
+            # drop the remote object too (guarded on .vif presence, not
+            # is_remote — a keep_local tiered volume reopened from its
+            # local .dat has is_remote=False but still owns the object);
+            # leftovers would otherwise orphan it, and the .vif would
+            # resurrect an empty volume on restart
+            from . import backend as _backend
+            vinfo = _backend.load_volume_info(base)
+            if vinfo and vinfo.get("files"):
+                fi = vinfo["files"][0]
+                try:
+                    _backend.get_backend(fi["backend_id"]).delete_file(
+                        fi["key"])
+                except _backend.BackendError:
+                    pass
             for ext in (".dat", ".vif"):
                 p = base + ext
                 if os.path.exists(p):

@@ -12,6 +12,7 @@ data read becomes a ranged GET through the backend abstraction.
 from __future__ import annotations
 
 import os
+import uuid
 
 from . import backend as _backend
 from .volume import Volume, VolumeError
@@ -28,7 +29,10 @@ def tier_upload(v: Volume, backend_id: str,
         was_read_only = v.read_only
         v.read_only = True  # seal: tiered volumes take no more writes
         base = v.file_name()
-        key = os.path.basename(base) + ".dat"
+        # unique key per upload: replicas of the same volume must not
+        # share (and so overwrite/delete) one bucket object
+        key = (f"{os.path.basename(base)}.dat."
+               f"{uuid.uuid4().hex[:12]}")
     try:
         # upload OUTSIDE the lock: the sealed .dat is immutable, and a
         # multi-GB transfer must not stall concurrent reads
@@ -56,9 +60,11 @@ def tier_download(v: Volume) -> int:
         raise VolumeError(f"volume {v.vid} is not tiered (no .vif)")
     fi = vinfo["files"][0]
     bs = _backend.get_backend(fi["backend_id"])
+    # download OUTSIDE the lock (multi-GB transfer must not stall reads);
+    # the remote object is immutable, so no consistency risk
+    tmp = base + ".dat.tmp"
+    size = bs.download_file(fi["key"], tmp)
     with v._lock:
-        tmp = base + ".dat.tmp"
-        size = bs.download_file(fi["key"], tmp)
         os.replace(tmp, base + ".dat")
         os.remove(_backend.vif_path(base))
         v._dat.close()
