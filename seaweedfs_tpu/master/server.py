@@ -330,19 +330,35 @@ class MasterServer:
         resp = web.StreamResponse(
             headers={"Content-Type": "application/x-ndjson"})
         await resp.prepare(req)
-        # initial full state (snapshot: heartbeats mutate these dicts)
-        for vid, locs in list(self.topo.volume_locations.items()):
-            for n in list(locs.values()):
-                await resp.write(json.dumps({
-                    "url": n.url, "public_url": n.public_url,
-                    "new_vids": [vid], "deleted_vids": []}).encode() + b"\n")
+        # register BEFORE writing the snapshot: each write awaits, and a
+        # delta published mid-snapshot would otherwise be lost to this
+        # subscriber forever (apply is idempotent, so the duplicate a
+        # racing delta can cause is harmless)
         q: asyncio.Queue = asyncio.Queue()
         self._watchers.append(q)
         try:
+            # initial full state (snapshot: heartbeats mutate these dicts)
+            for vid, locs in list(self.topo.volume_locations.items()):
+                for n in list(locs.values()):
+                    await resp.write(json.dumps({
+                        "url": n.url, "public_url": n.public_url,
+                        "new_vids": [vid],
+                        "deleted_vids": []}).encode() + b"\n")
+            # explicit end-of-snapshot marker so subscribers know when
+            # their map is complete (KeepConnected's initial sync boundary)
+            await resp.write(b'{"synced": true}\n')
             while True:
-                update = await q.get()
+                try:
+                    update = await asyncio.wait_for(q.get(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    # keepalive doubles as disconnect detection, so dead
+                    # subscribers don't pin the handler (and shutdown
+                    # isn't held hostage by the blocking q.get())
+                    await resp.write(b"\n")
+                    continue
                 await resp.write(json.dumps(update).encode() + b"\n")
-        except (asyncio.CancelledError, ConnectionResetError):
+        except (asyncio.CancelledError, ConnectionResetError,
+                ConnectionError):
             pass
         finally:
             self._watchers.remove(q)

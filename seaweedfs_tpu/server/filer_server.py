@@ -80,6 +80,12 @@ class FilerServer:
     async def start(self) -> None:
         self.client = WeedClient(self.master_url)
         await self.client.__aenter__()
+        # watch-fed location map: hot-path reads never lookup the master
+        # (reference filer embeds wdclient the same way)
+        from ..util.masterclient import MasterClient
+        self.master_client = MasterClient(self.master_url, name="filer")
+        await self.master_client.start()
+        self.client.attach_master_client(self.master_client)
         self.filer.chunk_deleter = self._queue_chunk_deletes
         self._pending: list[str] = []
         self._runner = web.AppRunner(self.app)
@@ -93,6 +99,9 @@ class FilerServer:
     async def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
+        mc = getattr(self, "master_client", None)
+        if mc is not None:
+            await mc.stop()
         if self.client:
             await self.client.__aexit__()
         if self._runner:

@@ -30,6 +30,7 @@ class WeedClient:
         # when the cluster enforces write JWTs, co-deployed components
         # (filer, chunk GC) mint their own tokens with the shared key
         self.jwt_key = jwt_key
+        self._master_client = None  # optional wdclient (attach_master_client)
 
     async def __aenter__(self) -> "WeedClient":
         if self._session is None:
@@ -67,8 +68,19 @@ class WeedClient:
             raise OperationError(f"assign: {body['error']}")
         return body
 
+    def attach_master_client(self, mc) -> None:
+        """Route lookups through a watch-fed MasterClient
+        (wdclient/masterclient.go) instead of per-vid HTTP requests."""
+        self._master_client = mc
+
     async def lookup(self, vid: str) -> list[dict]:
         """Volume locations with a TTL cache (lookup.go:10min)."""
+        mc = getattr(self, "_master_client", None)
+        if mc is not None:
+            locs = mc.lookup(int(vid))
+            if locs:
+                return [{"url": loc.url, "publicUrl": loc.public_url}
+                        for loc in locs]
         hit = self._vid_cache.get(vid)
         now = time.time()
         if hit and now - hit[0] < self._cache_ttl:
