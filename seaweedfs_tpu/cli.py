@@ -139,6 +139,17 @@ def build_parser() -> argparse.ArgumentParser:
     sc.add_argument("-config", default="security",
                     choices=["security", "master", "filer"])
 
+    mt = sub.add_parser("mount", help="mount the filer as a FUSE "
+                                      "filesystem (requires fusepy)")
+    _add_common(mt)
+    mt.add_argument("-dir", required=True, help="mount point")
+    mt.add_argument("-collection", default="")
+    mt.add_argument("-replication", default="")
+    mt.add_argument("-ttl", default="")
+    mt.add_argument("-chunkSizeLimitMB", type=int, default=4)
+    mt.add_argument("-filerStore", default="memory",
+                    help="embedded filer store backing the mount")
+
     sub.add_parser("version", help="print version")
     bench = sub.add_parser("bench-ec", help="TPU EC kernel benchmark "
                                             "(bench.py)")
@@ -547,6 +558,16 @@ def main(argv: list[str] | None = None) -> None:
         return
     if args.cmd == "compact":
         _run_compact(args)
+        return
+    if args.cmd == "mount":
+        from .filer.filer import Filer
+        from .mount.fuse_adapter import mount as fuse_mount
+        from .mount.wfs import MountOptions
+        fuse_mount(
+            Filer(args.filerStore), args.master, args.dir,
+            MountOptions(collection=args.collection,
+                         replication=args.replication, ttl=args.ttl,
+                         chunk_size_limit=args.chunkSizeLimitMB << 20))
         return
     if args.cmd == "bench-ec":
         import subprocess

@@ -100,17 +100,26 @@ def view_from_visibles(visibles: list[VisibleInterval], offset: int,
     stop = offset + size
     views: list[ChunkView] = []
     for v in visibles:
-        if v.start <= offset < v.stop and offset < stop:
-            end = min(v.stop, stop)
-            views.append(ChunkView(
-                file_id=v.file_id,
-                offset=v.chunk_offset + (offset - v.start),
-                size=end - offset,
-                logic_offset=offset,
-                is_full_chunk=(v.is_full_chunk and v.start == offset
-                               and v.stop <= stop),
-            ))
-            offset = end
+        if offset >= stop:
+            break
+        if v.stop <= offset:
+            continue
+        # jump across a hole: sparse ranges read as zeros (the reference's
+        # clip loop drops post-hole views — filechunks.go:89 — which loses
+        # data on sparse files; assemblers here zero-fill instead)
+        cur = max(offset, v.start)
+        if cur >= stop:
+            break
+        end = min(v.stop, stop)
+        views.append(ChunkView(
+            file_id=v.file_id,
+            offset=v.chunk_offset + (cur - v.start),
+            size=end - cur,
+            logic_offset=cur,
+            is_full_chunk=(v.is_full_chunk and v.start == cur
+                           and v.stop <= stop),
+        ))
+        offset = end
     return views
 
 

@@ -1,0 +1,36 @@
+"""Streaming read assembly over a chunk list.
+
+Reference: weed/filer2/stream.go:12-47 (StreamContent). Yields the bytes
+of [offset, offset+length) in order, zero-filling sparse holes between
+visible intervals and any short tail so the byte count always matches the
+declared length.
+"""
+
+from __future__ import annotations
+
+from .filechunks import FileChunk, view_from_chunks
+
+_ZERO_BLOCK = 64 * 1024
+
+
+async def stream_chunk_views(client, chunks: list[FileChunk], offset: int,
+                             length: int):
+    """Async-generate data blocks for [offset, offset+length).
+
+    `client.read(fid, offset, size)` failures propagate to the caller
+    (typically translated into a transport abort once headers are sent).
+    """
+    pos = offset
+    stop = offset + length
+    for view in view_from_chunks(chunks, offset, length):
+        while pos < view.logic_offset:  # hole: reads as zeros
+            n = min(_ZERO_BLOCK, view.logic_offset - pos)
+            yield b"\x00" * n
+            pos += n
+        data = await client.read(view.file_id, view.offset, view.size)
+        yield data
+        pos += len(data)
+    while pos < stop:  # tail hole / short chunk
+        n = min(_ZERO_BLOCK, stop - pos)
+        yield b"\x00" * n
+        pos += n

@@ -357,8 +357,7 @@ class MasterServer:
                     await resp.write(b"\n")
                     continue
                 await resp.write(json.dumps(update).encode() + b"\n")
-        except (asyncio.CancelledError, ConnectionResetError,
-                ConnectionError):
+        except (asyncio.CancelledError, ConnectionError):
             pass
         finally:
             self._watchers.remove(q)

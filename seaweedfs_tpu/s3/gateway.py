@@ -24,6 +24,7 @@ from aiohttp import web
 
 from ..filer.entry import Attr, Entry, new_directory_entry
 from ..filer.filechunks import FileChunk, etag as chunks_etag, view_from_chunks
+from ..filer.stream import stream_chunk_views
 from ..filer.filer import Filer, FilerError
 from ..util.client import OperationError, WeedClient
 from ..util.httprange import RangeError, parse_range
@@ -398,10 +399,14 @@ class S3Gateway:
         resp = web.StreamResponse(status=status, headers=headers)
         resp.content_type = ct
         await resp.prepare(req)
-        for view in view_from_chunks(entry.chunks, offset, length):
-            data = await self.client.read(view.file_id, view.offset,
-                                          view.size)
-            await resp.write(data)
+        try:
+            async for data in stream_chunk_views(self.client, entry.chunks,
+                                                 offset, length):
+                await resp.write(data)
+        except OperationError:
+            if req.transport is not None:
+                req.transport.close()
+            return resp
         await resp.write_eof()
         return resp
 

@@ -18,6 +18,7 @@ from ..filer.entry import Attr, Entry
 from ..filer.filechunks import (FileChunk, etag as chunks_etag, total_size,
                                 view_from_chunks)
 from ..filer.filer import Filer, FilerError
+from ..filer.stream import stream_chunk_views
 from ..util.client import OperationError, WeedClient
 from ..util.httprange import RangeError, parse_range
 
@@ -170,17 +171,16 @@ class FilerServer:
         resp.content_type = ct
         await resp.prepare(req)
         # stream chunk views (filer2/stream.go StreamContent)
-        for view in view_from_chunks(entry.chunks, offset, length):
-            try:
-                data = await self.client.read(view.file_id, view.offset,
-                                              view.size)
-            except OperationError:
-                # headers already sent: abort the connection so the client
-                # sees a transport error, not a silently short body
-                if req.transport is not None:
-                    req.transport.close()
-                return resp
-            await resp.write(data)
+        try:
+            async for data in stream_chunk_views(self.client, entry.chunks,
+                                                 offset, length):
+                await resp.write(data)
+        except OperationError:
+            # headers already sent: abort the connection so the client
+            # sees a transport error, not a silently short body
+            if req.transport is not None:
+                req.transport.close()
+            return resp
         await resp.write_eof()
         return resp
 

@@ -21,6 +21,7 @@ from aiohttp import web
 
 from ..filer.entry import Attr, Entry, new_directory_entry
 from ..filer.filechunks import FileChunk, view_from_chunks
+from ..filer.stream import stream_chunk_views
 from ..filer.filer import Filer, FilerError
 from ..util.client import OperationError, WeedClient
 from ..util.httprange import RangeError, parse_range
@@ -220,15 +221,14 @@ class WebDavServer:
         resp = web.StreamResponse(status=status, headers=headers)
         resp.content_type = ct
         await resp.prepare(req)
-        for view in view_from_chunks(entry.chunks, offset, length):
-            try:
-                data = await self.client.read(view.file_id, view.offset,
-                                              view.size)
-            except OperationError:
-                if req.transport is not None:
-                    req.transport.close()
-                return resp
-            await resp.write(data)
+        try:
+            async for data in stream_chunk_views(self.client, entry.chunks,
+                                                 offset, length):
+                await resp.write(data)
+        except OperationError:
+            if req.transport is not None:
+                req.transport.close()
+            return resp
         await resp.write_eof()
         return resp
 

@@ -77,7 +77,11 @@ class WeedClient:
         """Volume locations with a TTL cache (lookup.go:10min)."""
         mc = getattr(self, "_master_client", None)
         if mc is not None:
-            locs = mc.lookup(int(vid))
+            try:
+                vid_num = int(vid)
+            except ValueError as e:
+                raise OperationError(f"lookup: bad volume id {vid!r}") from e
+            locs = mc.lookup(vid_num)
             if locs:
                 return [{"url": loc.url, "publicUrl": loc.public_url}
                         for loc in locs]

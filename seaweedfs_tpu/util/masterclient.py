@@ -121,8 +121,11 @@ class MasterClient:
                 raise
             except _LeaderRedirect:
                 # _consume_stream already pointed current_master at the
-                # announced leader; follow it instead of rotating
+                # announced leader; follow it instead of rotating, but
+                # pause briefly so mutually-redirecting masters (election
+                # window) can't drive a tight reconnect loop
                 redirected = True
+                await asyncio.sleep(0.2)
             except Exception:
                 pass
             if not redirected:

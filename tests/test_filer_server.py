@@ -119,3 +119,39 @@ def test_overwrite_gc_and_mkdir(tmp_path):
                 assert await r.read() == b"formdata"
                 assert r.headers["Content-Type"].startswith("text/plain")
     run(body())
+
+
+def test_sparse_file_streams_zero_filled_holes(tmp_path):
+    """A hole between chunks must read back as zeros with a full-length
+    body (filer2/stream.go semantics; the view clip jumps holes)."""
+    async def body():
+        async with _cluster(tmp_path) as c:
+            f = c.filer
+            from seaweedfs_tpu.filer.filechunks import FileChunk
+            from seaweedfs_tpu.filer.entry import Attr, Entry
+            import time as _t
+
+            # store two real chunks, then register an entry whose chunk
+            # list leaves a hole [10, 20)
+            async with c.http.post(f"http://{f.url}/tmp/a", data=b"A" * 10) as r:
+                assert r.status == 201
+            async with c.http.post(f"http://{f.url}/tmp/b", data=b"B" * 10) as r:
+                assert r.status == 201
+            ea = f.filer.find_entry("/tmp/a")
+            eb = f.filer.find_entry("/tmp/b")
+            sparse = Entry("/sparse.bin", Attr(mtime=_t.time()), chunks=[
+                FileChunk(ea.chunks[0].file_id, 0, 10, 1),
+                FileChunk(eb.chunks[0].file_id, 20, 10, 2),
+            ])
+            f.filer.create_entry(sparse)
+            async with c.http.get(f"http://{f.url}/sparse.bin") as resp:
+                assert resp.status == 200
+                got = await resp.read()
+            assert got == b"A" * 10 + b"\x00" * 10 + b"B" * 10
+            # range read starting inside the hole
+            async with c.http.get(
+                    f"http://{f.url}/sparse.bin",
+                    headers={"Range": "bytes=15-24"}) as resp:
+                assert resp.status == 206
+                assert await resp.read() == b"\x00" * 5 + b"B" * 5
+    run(body())

@@ -1,0 +1,174 @@
+"""Mount-layer (FUSE node) semantics against an in-proc cluster.
+
+Mirrors the reference's weed/filesys behaviors: write-back dirty pages
+(contiguous coalescing, non-contiguous flush, oversized split), chunk
+overlay on overwrite, rename/remove with data GC, truncate clipping,
+xattr on Entry.extended.
+"""
+
+import asyncio
+
+import pytest
+
+from seaweedfs_tpu.filer.filechunks import total_size
+from seaweedfs_tpu.filer.filer import Filer
+from seaweedfs_tpu.mount.dir import Dir, MountError
+from seaweedfs_tpu.mount.wfs import WFS, MountOptions
+
+from cluster_util import Cluster, run
+
+
+async def _with_wfs(tmpdir, fn, chunk_limit=1024):
+    async with Cluster(str(tmpdir), n_servers=2) as c:
+        wfs = WFS(Filer("memory"), c.master.url.replace("http://", ""),
+                  MountOptions(chunk_size_limit=chunk_limit))
+        await wfs.start()
+        try:
+            return await fn(c, wfs)
+        finally:
+            await wfs.close()
+
+
+def test_write_read_roundtrip(tmp_path):
+    async def body(c, wfs):
+        root = wfs.root
+        f, fh = await root.create("hello.txt")
+        data = b"hello, tpu-native world"
+        assert await fh.write(0, data) == len(data)
+        await fh.flush()
+        await fh.release()
+
+        # fresh node: read through views
+        node = await root.lookup("hello.txt")
+        fh2 = node.open()
+        assert await fh2.read(0, 4096) == data
+        assert await fh2.read(7, 3) == data[7:10]
+        a = await node.attr()
+        assert a["size"] == len(data)
+        await fh2.release()
+
+    run(_with_wfs(tmp_path, body))
+
+
+def test_contiguous_writes_coalesce_one_chunk(tmp_path):
+    async def body(c, wfs):
+        f, fh = await wfs.root.create("seq.bin")
+        for i in range(8):
+            await fh.write(i * 100, bytes([i]) * 100)
+        await fh.flush()
+        assert len(f.entry.chunks) == 1  # coalesced in the dirty buffer
+        fh2 = (await wfs.root.lookup("seq.bin")).open()
+        got = await fh2.read(0, 800)
+        assert got == b"".join(bytes([i]) * 100 for i in range(8))
+
+    run(_with_wfs(tmp_path, body))
+
+
+def test_noncontiguous_write_forces_flush(tmp_path):
+    async def body(c, wfs):
+        f, fh = await wfs.root.create("gap.bin")
+        await fh.write(0, b"a" * 100)
+        await fh.write(500, b"b" * 100)   # gap -> flush first range
+        await fh.write(100, b"c" * 100)   # backwards -> flush again
+        await fh.flush()
+        assert len(f.entry.chunks) == 3
+        fh2 = (await wfs.root.lookup("gap.bin")).open()
+        got = await fh2.read(0, 600)
+        assert got[:100] == b"a" * 100
+        assert got[100:200] == b"c" * 100
+        assert got[500:600] == b"b" * 100
+
+    run(_with_wfs(tmp_path, body))
+
+
+def test_oversized_write_splits_chunks(tmp_path):
+    async def body(c, wfs):
+        f, fh = await wfs.root.create("big.bin")
+        blob = bytes(range(256)) * 16  # 4096 bytes, chunk limit 1024
+        await fh.write(0, blob)
+        await fh.flush()
+        assert len(f.entry.chunks) == 4
+        fh2 = (await wfs.root.lookup("big.bin")).open()
+        assert await fh2.read(0, len(blob)) == blob
+
+    run(_with_wfs(tmp_path, body))
+
+
+def test_overwrite_overlay_and_gc(tmp_path):
+    async def body(c, wfs):
+        f, fh = await wfs.root.create("ow.bin")
+        await fh.write(0, b"x" * 300)
+        await fh.flush()
+        await fh.write(100, b"y" * 100)
+        await fh.flush()
+        fh2 = (await wfs.root.lookup("ow.bin")).open()
+        got = await fh2.read(0, 300)
+        assert got == b"x" * 100 + b"y" * 100 + b"x" * 100
+
+    run(_with_wfs(tmp_path, body))
+
+
+def test_mkdir_readdir_rename_remove(tmp_path):
+    async def body(c, wfs):
+        d = await wfs.root.mkdir("docs")
+        f, fh = await d.create("a.txt")
+        await fh.write(0, b"A")
+        await fh.flush()
+        await fh.release()
+        names = [e.name for e in await d.read_dir_all()]
+        assert names == ["a.txt"]
+
+        # rename into a sibling dir
+        d2 = await wfs.root.mkdir("archive")
+        await d.rename("a.txt", d2, "b.txt")
+        assert [e.name for e in await d2.read_dir_all()] == ["b.txt"]
+        with pytest.raises(MountError):
+            await d.lookup("a.txt")
+        node = await d2.lookup("b.txt")
+        assert await node.open().read(0, 10) == b"A"
+
+        # rmdir non-empty fails; file remove drops chunks
+        with pytest.raises(MountError):
+            await wfs.root.remove("archive", is_dir=True)
+        await d2.remove("b.txt")
+        deleted = await wfs.drain_deletes()
+        assert deleted >= 1
+        await wfs.root.remove("archive", is_dir=True)
+
+    run(_with_wfs(tmp_path, body))
+
+
+def test_truncate_clips_chunks(tmp_path):
+    async def body(c, wfs):
+        f, fh = await wfs.root.create("t.bin")
+        await fh.write(0, b"q" * 1000)
+        await fh.write(1000, b"r" * 1000)  # second chunk after flush
+        await fh.flush()
+        node = await wfs.root.lookup("t.bin")
+        await node.setattr(size=1500)
+        entry = wfs.filer.find_entry("/t.bin")
+        assert total_size(entry.chunks) == 1500
+        await node.setattr(size=0)
+        entry = wfs.filer.find_entry("/t.bin")
+        assert entry.chunks == []
+
+    run(_with_wfs(tmp_path, body, chunk_limit=1000))
+
+
+def test_xattr(tmp_path):
+    async def body(c, wfs):
+        f, fh = await wfs.root.create("x.txt")
+        await fh.flush()
+        node = await wfs.root.lookup("x.txt")
+        await node.set_xattr("user.tag", b"\x01\x02")
+        assert await node.get_xattr("user.tag") == b"\x01\x02"
+        assert await node.list_xattr() == ["user.tag"]
+        await node.remove_xattr("user.tag")
+        with pytest.raises(MountError):
+            await node.get_xattr("user.tag")
+
+        d = await wfs.root.mkdir("xd")
+        await d.set_xattr("user.k", b"v")
+        assert await d.get_xattr("user.k") == b"v"
+
+    run(_with_wfs(tmp_path, body))
