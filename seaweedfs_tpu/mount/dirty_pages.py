@@ -53,6 +53,14 @@ class ContinuousDirtyPages:
             self.size = len(data)
             return chunks
 
+        if self.size == 0:
+            # empty buffer (fresh handle, or just flushed): restart the
+            # window wherever this write lands
+            self.offset = offset
+            self.data[:len(data)] = data
+            self.size = len(data)
+            return chunks
+
         if offset != self.offset + self.size:
             if offset == self.offset and self.size < len(data):
                 # re-write from the start that extends the buffered range

@@ -50,7 +50,9 @@ class File:
         """file.go Open (:68-74): register a handle."""
         self.is_open = True
         fh = FileHandle(self, uid, gid)
-        self.wfs.handles[self.full_path] = fh
+        # registry keyed by a unique handle id: concurrent opens of one
+        # path must not clobber each other
+        self.wfs.handles[id(fh)] = fh
         return fh
 
     def add_chunks(self, chunks: list[FileChunk]) -> None:
@@ -128,16 +130,16 @@ class FileHandle:
         """filehandle.go Read (:49-77): clip views, gather chunk reads
         concurrently, assemble in logical order."""
         entry = await self.file.maybe_load_entry()
-        if not entry.chunks:
+        end = min(offset + size, total_size(entry.chunks))
+        if end <= offset:
             return b""
         views = self.file.views(offset, size)
-        if not views:
-            return b""
         parts = await asyncio.gather(*(
             self.file.wfs.read_chunk(v.file_id, v.offset, v.size)
             for v in views))
-        buf = bytearray(max(v.logic_offset + v.size
-                            for v in views) - offset)
+        # zero-filled buffer: sparse holes (incl. trailing ones) read as
+        # zeros, consistent with the HTTP streamers
+        buf = bytearray(end - offset)
         for v, part in zip(views, parts):
             at = v.logic_offset - offset
             buf[at:at + len(part)] = part
@@ -173,4 +175,4 @@ class FileHandle:
         """filehandle.go Release (:115-125)."""
         self.dirty_pages.release()
         self.file.is_open = False
-        self.file.wfs.handles.pop(self.file.full_path, None)
+        self.file.wfs.handles.pop(id(self), None)

@@ -63,6 +63,15 @@ class SeaweedFuseOps(Operations):  # pragma: no cover - needs a kernel
         self.lt.run(wfs.start())
         self._handles: dict[int, object] = {}
         self._next_fh = 1
+        self._fh_lock = threading.Lock()
+
+    def _alloc_fh(self, handle) -> int:
+        # kernel callbacks run on concurrent threads (nothreads=False)
+        with self._fh_lock:
+            fh = self._next_fh
+            self._next_fh += 1
+            self._handles[fh] = handle
+            return fh
 
     def _node(self, path: str):
         if path in ("/", ""):
@@ -126,19 +135,13 @@ class SeaweedFuseOps(Operations):  # pragma: no cover - needs a kernel
         parent, _, name = path.rstrip("/").rpartition("/")
         _, handle = self.lt.run(
             Dir(parent or "/", self.wfs).create(name, mode))
-        fh = self._next_fh
-        self._next_fh += 1
-        self._handles[fh] = handle
-        return fh
+        return self._alloc_fh(handle)
 
     def open(self, path, flags):
         node = self._node(path)
         if not isinstance(node, File):
             raise FuseOSError(errno.EISDIR)
-        fh = self._next_fh
-        self._next_fh += 1
-        self._handles[fh] = node.open()
-        return fh
+        return self._alloc_fh(node.open())
 
     def read(self, path, size, offset, fh):
         return self.lt.run(self._handles[fh].read(offset, size))
@@ -161,10 +164,9 @@ class SeaweedFuseOps(Operations):  # pragma: no cover - needs a kernel
     # -- xattr --
 
     def getxattr(self, path, name, position=0):
-        try:
-            return self.lt.run(self._node(path).get_xattr(name))
-        except FuseOSError:
-            raise FuseOSError(errno.ENODATA)
+        # missing path propagates as ENOENT; missing attr is already
+        # ENODATA from the node layer
+        return self.lt.run(self._node(path).get_xattr(name))
 
     def setxattr(self, path, name, value, options, position=0):
         self.lt.run(self._node(path).set_xattr(name, value))

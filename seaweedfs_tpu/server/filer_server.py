@@ -15,8 +15,7 @@ import aiohttp
 from aiohttp import web
 
 from ..filer.entry import Attr, Entry
-from ..filer.filechunks import (FileChunk, etag as chunks_etag, total_size,
-                                view_from_chunks)
+from ..filer.filechunks import (FileChunk, etag as chunks_etag, total_size)
 from ..filer.filer import Filer, FilerError
 from ..filer.stream import stream_chunk_views
 from ..util.client import OperationError, WeedClient

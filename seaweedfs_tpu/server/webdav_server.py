@@ -332,19 +332,19 @@ class WebDavServer:
                 await self._copy_recursive(
                     child, dest + "/" + child.name)
             return
-        # re-upload data so source and copy have independent chunks
+        # re-upload data so source and copy have independent chunks;
+        # place each copied view at its logical offset so sparse holes
+        # survive the copy
         chunks: list[FileChunk] = []
-        offset = 0
         for view in view_from_chunks(src.chunks, 0, src.size):
             data = await self.client.read(view.file_id, view.offset,
                                           view.size)
             fid = await self.client.upload_data(
                 data, collection=self.collection,
                 replication=self.replication)
-            chunks.append(FileChunk(file_id=fid, offset=offset,
+            chunks.append(FileChunk(file_id=fid, offset=view.logic_offset,
                                     size=len(data),
                                     mtime=time.time_ns()))
-            offset += len(data)
         now = time.time()
         self.filer.create_entry(Entry(
             full_path=dest,

@@ -172,3 +172,31 @@ def test_xattr(tmp_path):
         assert await d.get_xattr("user.k") == b"v"
 
     run(_with_wfs(tmp_path, body))
+
+
+def test_sparse_read_zero_fills_and_eof(tmp_path):
+    async def body(c, wfs):
+        f, fh = await wfs.root.create("sp.bin")
+        await fh.write(0, b"a" * 10)
+        await fh.write(20, b"b" * 10)  # hole [10,20)
+        await fh.flush()
+        node = await wfs.root.lookup("sp.bin")
+        fh2 = node.open()
+        assert await fh2.read(0, 30) == b"a" * 10 + b"\0" * 10 + b"b" * 10
+        assert await fh2.read(12, 5) == b"\0" * 5   # inside the hole
+        assert await fh2.read(0, 15) == b"a" * 10 + b"\0" * 5
+        assert await fh2.read(30, 10) == b""        # EOF
+    run(_with_wfs(tmp_path, body))
+
+
+def test_fsync_then_sequential_writes_still_coalesce(tmp_path):
+    async def body(c, wfs):
+        f, fh = await wfs.root.create("fs.bin")
+        await fh.write(0, b"x" * 500)
+        await fh.flush()                 # periodic fsync
+        for i in range(5):
+            await fh.write(500 + i * 100, bytes([i]) * 100)
+        await fh.flush()
+        # post-fsync sequential writes coalesce into ONE more chunk
+        assert len(f.entry.chunks) == 2
+    run(_with_wfs(tmp_path, body))
