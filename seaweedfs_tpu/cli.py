@@ -53,11 +53,32 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(f)
     f.add_argument("-port", type=int, default=8888)
     f.add_argument("-store", default="sqlite",
-                   help="filer store: memory|sqlite")
+                   help="filer store: memory|sqlite|leveldb|leveldb2|sql "
+                        "(+redis/mysql/postgres/etcd/cassandra when "
+                        "drivers are installed)")
     f.add_argument("-dbPath", default="./filer.db")
     f.add_argument("-chunkSizeMB", type=int, default=32)
     f.add_argument("-collection", default="")
     f.add_argument("-replication", default="")
+    f.add_argument("-notify", default="",
+                   help="publish meta changes: file:<path> | sqlite:<path> "
+                        "| log")
+
+    fr = sub.add_parser("filer.replicate",
+                        help="replay filer meta events into a sink")
+    fr.add_argument("-notify", required=True,
+                    help="subscription input: file:<path> | sqlite:<path>")
+    fr.add_argument("-sourceMaster", required=True,
+                    help="source cluster master host:port")
+    fr.add_argument("-sourceDir", default="/",
+                    help="replicate only this subtree")
+    fr.add_argument("-sink", required=True,
+                    help="filer:<filerHost:port>@<targetMaster> | "
+                         "s3:<endpointUrl>/<bucket> | local:<dir>")
+    fr.add_argument("-sinkDir", default="/")
+    fr.add_argument("-progress", default="./replicate.progress")
+    fr.add_argument("-once", action="store_true",
+                    help="process the backlog and exit")
 
     s3p = sub.add_parser("s3", help="start an S3 gateway")
     _add_common(s3p)
@@ -194,11 +215,23 @@ async def _run_volume(args) -> None:
     await asyncio.Event().wait()
 
 
+def _store_kwargs(store: str, db_path: str) -> dict:
+    if store in ("sqlite", "sql"):
+        return {"path": db_path}
+    if store in ("leveldb", "leveldb2"):
+        return {"dir": db_path}
+    return {}
+
+
 async def _run_filer(args) -> None:
     from .filer.filer import Filer
     from .server.filer_server import FilerServer
-    kwargs = {"path": args.dbPath} if args.store == "sqlite" else {}
-    fs = FilerServer(Filer(args.store, **kwargs), args.master,
+    kwargs = _store_kwargs(args.store, args.dbPath)
+    filer = Filer(args.store, **kwargs)
+    if args.notify:
+        from .notification.queues import attach_to_filer
+        attach_to_filer(filer, _make_queue(args.notify))
+    fs = FilerServer(filer, args.master,
                      ip=args.ip, port=args.port,
                      chunk_size=args.chunkSizeMB * 1024 * 1024,
                      collection=args.collection,
@@ -208,10 +241,60 @@ async def _run_filer(args) -> None:
     await asyncio.Event().wait()
 
 
+def _make_queue(spec: str):
+    from .notification.queues import FileQueue, LogQueue, SqliteQueue
+    if spec == "log":
+        return LogQueue()
+    kind, _, path = spec.partition(":")
+    if kind == "file" and path:
+        return FileQueue(path)
+    if kind == "sqlite" and path:
+        return SqliteQueue(path)
+    raise SystemExit(f"bad -notify spec {spec!r}; "
+                     f"use log | file:<path> | sqlite:<path>")
+
+
+def _make_sink(spec: str, sink_dir: str):
+    from .replication.sink import FilerSink, LocalDirSink, S3Sink
+    kind, _, rest = spec.partition(":")
+    if kind == "filer":
+        target, _, master = rest.partition("@")
+        if not (target and master):
+            raise SystemExit(
+                "bad -sink: filer:<filerHost:port>@<targetMaster>")
+        return FilerSink(target, master, directory=sink_dir)
+    if kind == "s3":
+        endpoint, _, bucket = rest.rpartition("/")
+        if not (endpoint and bucket):
+            raise SystemExit("bad -sink: s3:<endpointUrl>/<bucket>")
+        return S3Sink(endpoint, bucket, directory=sink_dir)
+    if kind == "local":
+        return LocalDirSink(rest)
+    raise SystemExit(f"unknown sink kind {kind!r}")
+
+
+async def _run_filer_replicate(args) -> None:
+    from .replication.replicator import Replicator
+    from .replication.runner import replicate_from_queue
+    from .replication.source import FilerSource
+    queue = _make_queue(args.notify)
+    sink = _make_sink(args.sink, args.sinkDir)
+    async with FilerSource(args.sourceMaster, args.sourceDir) as src:
+        await sink.start()
+        try:
+            n = await replicate_from_queue(
+                queue, Replicator(src, sink), args.progress,
+                once=args.once)
+            if args.once:
+                print(f"replicated {n} events")
+        finally:
+            await sink.close()
+
+
 async def _run_s3(args) -> None:
     from .filer.filer import Filer
     from .s3.gateway import S3Gateway
-    kwargs = {"path": args.dbPath} if args.store == "sqlite" else {}
+    kwargs = _store_kwargs(args.store, args.dbPath)
     s3 = S3Gateway(Filer(args.store, **kwargs), args.master,
                    ip=args.ip, port=args.port)
     await s3.start()
@@ -222,7 +305,7 @@ async def _run_s3(args) -> None:
 async def _run_webdav(args) -> None:
     from .filer.filer import Filer
     from .server.webdav_server import WebDavServer
-    kwargs = {"path": args.dbPath} if args.store == "sqlite" else {}
+    kwargs = _store_kwargs(args.store, args.dbPath)
     wd = WebDavServer(Filer(args.store, **kwargs), args.master,
                       ip=args.ip, port=args.port,
                       collection=args.collection,
@@ -579,7 +662,7 @@ def main(argv: list[str] | None = None) -> None:
         "s3": _run_s3, "server": _run_server, "upload": _run_upload,
         "download": _run_download, "shell": _run_shell,
         "benchmark": _run_benchmark, "backup": _run_backup,
-        "webdav": _run_webdav,
+        "webdav": _run_webdav, "filer.replicate": _run_filer_replicate,
     }
     try:
         asyncio.run(runners[args.cmd](args))

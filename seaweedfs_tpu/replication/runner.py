@@ -1,0 +1,63 @@
+"""The `filer.replicate` process loop.
+
+Reference: weed/command/filer_replication.go:37-130 — subscribe to the
+notification input, replay each event through the Replicator, persist
+consumption progress (the kafka input's offset file,
+sub/notification_kafka.go:88-140).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+from ..notification.queues import FileQueue, SqliteQueue
+from .replicator import Replicator
+
+
+def _load_progress(path: str) -> int:
+    try:
+        with open(path) as f:
+            return int(json.load(f)["offset"])
+    except (OSError, ValueError, KeyError):
+        return 0
+
+
+def _save_progress(path: str, offset: int) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"offset": offset}, f)
+    os.replace(tmp, path)
+
+
+async def replicate_from_queue(queue, replicator: Replicator,
+                               progress_path: str,
+                               poll_interval: float = 0.5,
+                               once: bool = False) -> int:
+    """Drain the queue into the sink; returns events applied. With
+    once=True, process the current backlog and return (for tests and
+    batch catch-up runs)."""
+    offset = _load_progress(progress_path)
+    applied = 0
+    while True:
+        if isinstance(queue, FileQueue):
+            msgs, offset = queue.read_from(offset)
+            batch = msgs
+        elif isinstance(queue, SqliteQueue):
+            rows = queue.read_after(offset)
+            batch = [m for _, m in rows]
+            if rows:
+                offset = rows[-1][0]
+        else:
+            raise ValueError(
+                f"unsupported subscription input {type(queue).__name__}; "
+                f"use a file or sqlite queue")
+        for msg in batch:
+            await replicator.replicate(msg["key"], msg["event"])
+            applied += 1
+        if batch:
+            _save_progress(progress_path, offset)
+        if once:
+            return applied
+        await asyncio.sleep(poll_interval)
