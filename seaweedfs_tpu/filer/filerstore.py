@@ -70,9 +70,12 @@ def create_store(name: str, **kwargs) -> FilerStore:
 
 
 def _load_builtin() -> None:
-    from .stores import memory_store, sqlite_store  # noqa: F401
-    # optional drivers, reference's mysql/postgres/cassandra/redis/etcd/tikv
-    for mod in ("redis_store", "mysql_store"):
+    from .stores import (abstract_sql_store, leveldb2_store,  # noqa: F401
+                         leveldb_store, memory_store, sqlite_store)
+    # driver-gated plugins (reference: mysql/postgres via abstract_sql —
+    # registered inside abstract_sql_store when drivers import — plus
+    # cassandra/redis/etcd below)
+    for mod in ("redis_store", "etcd_store", "cassandra_store"):
         try:
             __import__(f"seaweedfs_tpu.filer.stores.{mod}")
         except ImportError:
