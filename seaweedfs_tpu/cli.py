@@ -32,6 +32,9 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-defaultReplication", default="000")
     m.add_argument("-pulseSeconds", type=float, default=5.0)
     m.add_argument("-jwtKey", default="")
+    m.add_argument("-peers", default="",
+                   help="comma-separated peer masters host:port "
+                        "(enables leader election)")
     m.add_argument("-metricsGateway", default="",
                    help="prometheus push-gateway host:port")
 
@@ -185,7 +188,9 @@ async def _run_master(args) -> None:
     m = MasterServer(ip=args.ip, port=args.port,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
-                     pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey)
+                     pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
+                     peers=[p.strip() for p in args.peers.split(",")
+                            if p.strip()])
     await m.start()
     if args.metricsGateway:
         from .stats.metrics import push_loop

@@ -1,0 +1,223 @@
+"""Leader election among masters: raft-lite over HTTP.
+
+Reference: weed/server/raft_server.go:28-97 wraps chrislusf/raft, but the
+usage is shallow — peer membership plus ONE replicated value, MaxVolumeId
+(topology/cluster_commands.go:9-29), with leader identity surfaced to
+volume servers in heartbeat responses (master_grpc_server.go:165-175) and
+non-leader HTTP proxied to the leader (master_server.go:153-185).
+
+This module re-expresses exactly that contract as term-based election
+(RequestVote / AppendEntries-style leader pulses) without a general
+replicated log: the single replicated value rides on the leader pulse.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+import aiohttp
+
+
+class Election:
+    FOLLOWER = "follower"
+    CANDIDATE = "candidate"
+    LEADER = "leader"
+
+    @staticmethod
+    def _norm(url: str) -> str:
+        host, _, port = url.strip().partition(":")
+        if host in ("localhost", ""):
+            host = "127.0.0.1"
+        return f"{host}:{port}"
+
+    def __init__(self, me: str, peers: list[str],
+                 election_timeout: tuple[float, float] = (1.0, 2.0),
+                 pulse: float = 0.3):
+        self.me = self._norm(me)
+        # peers excludes self (normalized, so localhost == 127.0.0.1);
+        # empty peers == single-master mode
+        self.peers = [p for p in map(self._norm, peers) if p != self.me]
+        self.single = not self.peers
+        self.majority = (len(self.peers) + 1) // 2 + 1
+        self.timeout_range = election_timeout
+        self.pulse = pulse
+        self.term = 0
+        self.voted_for: str | None = None
+        self.role = self.LEADER if self.single else self.FOLLOWER
+        self.leader: str | None = self.me if self.single else None
+        self.last_pulse = time.monotonic()
+        # last time a leader pulse round reached a quorum (leader lease)
+        self._last_quorum = time.monotonic()
+        # replicated value (MaxVolumeId) exchange hooks, set by MasterServer
+        self.get_max_volume_id = lambda: 0
+        self.adopt_max_volume_id = lambda v: None
+        self._http: aiohttp.ClientSession | None = None
+        self._task: asyncio.Task | None = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self.role == self.LEADER
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        if self.single:
+            return
+        self._http = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=max(self.pulse * 2, 0.5)))
+        self.last_pulse = time.monotonic()
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._http:
+            await self._http.close()
+
+    # ---- incoming RPCs (wired as HTTP handlers by MasterServer) ----
+
+    def on_vote_request(self, term: int, candidate: str,
+                        max_volume_id: int = 0) -> dict:
+        if self.single:
+            # a single-mode master is not part of any quorum; never let a
+            # misconfigured peer demote it (it has no loop to recover)
+            return {"term": self.term, "granted": False}
+        if candidate == self.me:
+            # our own vote request routed back to us through a peer-list
+            # entry that is really our address: only the local self-vote
+            # in _campaign counts
+            return {"term": self.term, "granted": False}
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._step_down()
+        # up-to-date check on the one replicated value: never elect a
+        # candidate that would reissue already-used volume ids (the
+        # raft log-freshness vote rule collapsed to MaxVolumeId)
+        granted = (term == self.term
+                   and self.voted_for in (None, candidate)
+                   and max_volume_id >= self.get_max_volume_id())
+        if granted:
+            self.voted_for = candidate
+            self.last_pulse = time.monotonic()
+        return {"term": self.term, "granted": granted}
+
+    def on_leader_pulse(self, term: int, leader: str,
+                        max_volume_id: int) -> dict:
+        if self.single:
+            return {"term": self.term, "ok": False}
+        if term >= self.term:
+            if term > self.term:
+                self.voted_for = None
+            self.term = term
+            self.leader = leader
+            if leader != self.me:
+                self._step_down()
+            self.last_pulse = time.monotonic()
+            self.adopt_max_volume_id(max_volume_id)
+            return {"term": self.term, "ok": True}
+        return {"term": self.term, "ok": False}
+
+    def _step_down(self) -> None:
+        if self.role != self.FOLLOWER:
+            self.role = self.FOLLOWER
+
+    # ---- the election / heartbeat loop ----
+
+    async def _loop(self) -> None:
+        while True:
+            if self.role == self.LEADER:
+                await self._broadcast_pulse()
+                # leader lease: a leader partitioned from every peer must
+                # stop serving writes before the others elect a successor,
+                # or two masters assign volume ids concurrently
+                if time.monotonic() - self._last_quorum \
+                        > self.timeout_range[0] * 0.8:
+                    self._step_down()
+                    self.leader = None
+                    self.last_pulse = time.monotonic()
+                await asyncio.sleep(self.pulse)
+            else:
+                timeout = random.uniform(*self.timeout_range)
+                await asyncio.sleep(self.pulse / 2)
+                if time.monotonic() - self.last_pulse > timeout:
+                    await self._campaign()
+
+    async def _campaign(self) -> None:
+        self.role = self.CANDIDATE
+        self.term += 1
+        term = self.term
+        self.voted_for = self.me
+        self.leader = None
+        votes = 1  # self-vote
+
+        async def ask(peer: str) -> bool:
+            try:
+                async with self._http.post(
+                        f"http://{peer}/raft/vote",
+                        json={"term": term, "candidate": self.me,
+                              "max_volume_id": self.get_max_volume_id()},
+                ) as resp:
+                    body = await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                return False
+            if body.get("term", 0) > self.term:
+                self.term = body["term"]
+                self.voted_for = None
+                self._step_down()
+            return bool(body.get("granted"))
+
+        results = await asyncio.gather(*(ask(p) for p in self.peers))
+        votes += sum(results)
+        if self.role == self.CANDIDATE and self.term == term \
+                and votes >= self.majority:
+            self.role = self.LEADER
+            self.leader = self.me
+            self._last_quorum = time.monotonic()
+            await self._broadcast_pulse()
+        else:
+            self._step_down()
+
+    async def _broadcast_pulse(self) -> int:
+        """One leader pulse round. Returns the ack count (incl. self) and
+        refreshes the leader lease when it reaches a quorum."""
+        body = {"term": self.term, "leader": self.me,
+                "max_volume_id": self.get_max_volume_id()}
+
+        async def send(peer: str) -> bool:
+            try:
+                async with self._http.post(
+                        f"http://{peer}/raft/heartbeat", json=body) as resp:
+                    reply = await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                return False
+            if reply.get("term", 0) > self.term:
+                self.term = reply["term"]
+                self.voted_for = None
+                self._step_down()
+                return False
+            return bool(reply.get("ok"))
+
+        results = await asyncio.gather(*(send(p) for p in self.peers))
+        acks = 1 + sum(results)
+        if acks >= self.majority:
+            self._last_quorum = time.monotonic()
+        return acks
+
+    async def commit_max_volume_id(self) -> bool:
+        """Synchronously replicate the current MaxVolumeId to a quorum.
+
+        The reference raft-commits MaxVolumeIdCommand before using a grown
+        volume id (cluster_commands.go:23); a value not acked by a
+        majority may be lost on leader crash and reissued."""
+        if self.single:
+            return True
+        if not self.is_leader:
+            return False
+        acks = await self._broadcast_pulse()
+        return acks >= self.majority

@@ -22,6 +22,7 @@ from ..storage.super_block import ReplicaPlacement
 from ..topology.layout import (LayoutKey, PlacementError, VolumeLayout,
                                find_empty_slots)
 from ..topology.tree import DataNode, Topology
+from .election import Election
 from .sequence import MemorySequencer
 
 
@@ -31,9 +32,16 @@ class MasterServer:
                  default_replication: str = "000",
                  pulse_seconds: float = 5.0,
                  garbage_threshold: float = 0.3,
-                 jwt_key: str = ""):
+                 jwt_key: str = "",
+                 peers: list[str] | None = None,
+                 election_timeout: tuple[float, float] = (1.0, 2.0),
+                 election_pulse: float = 0.3):
         self.ip = ip
         self.port = port
+        self._peers = list(peers or [])
+        self._election_timeout = election_timeout
+        self._election_pulse = election_pulse
+        self.election: Election | None = None
         self.jwt_key = jwt_key
         self.volume_size_limit = volume_size_limit_mb * 1024 * 1024
         self.default_replication = default_replication
@@ -64,6 +72,8 @@ class MasterServer:
         app.router.add_route("*", "/col/delete", self.h_collection_delete)
         app.router.add_get("/vol/volumes", self.h_volumes)
         app.router.add_get("/vol/ec_lookup", self.h_ec_lookup)
+        app.router.add_post("/raft/vote", self.h_raft_vote)
+        app.router.add_post("/raft/heartbeat", self.h_raft_heartbeat)
         return app
 
     @property
@@ -79,9 +89,18 @@ class MasterServer:
         await self._site.start()
         if self.port == 0:
             self.port = self._site._server.sockets[0].getsockname()[1]
+        self.election = Election(
+            self.url, self._peers,
+            election_timeout=self._election_timeout,
+            pulse=self._election_pulse)
+        self.election.get_max_volume_id = lambda: self.topo.max_volume_id
+        self.election.adopt_max_volume_id = self._adopt_max_volume_id
+        await self.election.start()
         self._tasks.append(asyncio.create_task(self._liveness_loop()))
 
     async def stop(self) -> None:
+        if self.election:
+            await self.election.stop()
         for task in self._tasks:
             task.cancel()
         if self._http:
@@ -111,6 +130,52 @@ class MasterServer:
                         and m.size < self.volume_size_limit)
             lay.set_writable(m.id, writable)
 
+    # ---- leadership ----
+
+    @property
+    def is_leader(self) -> bool:
+        return self.election is None or self.election.is_leader
+
+    @property
+    def leader_url(self) -> str | None:
+        return self.url if self.election is None else self.election.leader
+
+    def _adopt_max_volume_id(self, v: int) -> None:
+        """Follower side of the one replicated raft value
+        (cluster_commands.go:23 MaxVolumeIdCommand)."""
+        self.topo.max_volume_id = max(self.topo.max_volume_id, v)
+
+    async def h_raft_vote(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        return web.json_response(self.election.on_vote_request(
+            int(body["term"]), body["candidate"],
+            int(body.get("max_volume_id", 0))))
+
+    async def h_raft_heartbeat(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        return web.json_response(self.election.on_leader_pulse(
+            int(body["term"]), body["leader"],
+            int(body.get("max_volume_id", 0))))
+
+    async def _proxy_to_leader(self, req: web.Request) -> web.Response:
+        """Non-leader HTTP forwards to the leader
+        (proxyToLeader, master_server.go:153-185)."""
+        leader = self.leader_url
+        if not leader or leader == self.url:
+            return web.json_response(
+                {"error": "no leader elected yet"}, status=503)
+        data = await req.read()
+        try:
+            async with self._http.request(
+                    req.method, f"http://{leader}{req.path_qs}",
+                    data=data or None) as resp:
+                return web.Response(body=await resp.read(),
+                                    status=resp.status,
+                                    content_type=resp.content_type)
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            return web.json_response(
+                {"error": f"proxy to leader {leader}: {e}"}, status=502)
+
     # ---- handlers ----
 
     async def h_health(self, req: web.Request) -> web.Response:
@@ -122,6 +187,11 @@ class MasterServer:
                             content_type="text/plain")
 
     async def h_heartbeat(self, req: web.Request) -> web.Response:
+        if not self.is_leader:
+            # volume servers must register with the leader; hand back the
+            # hint so they chase it (master_grpc_server.go:165-175)
+            return web.json_response(
+                {"rejected": True, "leader": self.leader_url or ""})
         from ..stats import metrics
         if metrics.HAVE_PROMETHEUS:
             metrics.MASTER_RECEIVED_HEARTBEATS.inc()
@@ -145,6 +215,8 @@ class MasterServer:
         })
 
     async def h_assign(self, req: web.Request) -> web.Response:
+        if not self.is_leader:
+            return await self._proxy_to_leader(req)
         q = req.query
         count = int(q.get("count", 1) or 1)
         collection = q.get("collection", "")
@@ -197,6 +269,11 @@ class MasterServer:
         (volume_growth.go:204-230, allocate_volume.go)."""
         nodes = find_empty_slots(self.topo, rp, data_center or None)
         vid = self.topo.next_volume_id()
+        if self.election and not await self.election.commit_max_volume_id():
+            # the new id must reach a majority before any volume exists
+            # under it, or a successor leader could reissue it
+            raise PlacementError(
+                f"vid {vid}: MaxVolumeId not replicated to a quorum")
         for n in nodes:
             async with self._http.post(
                     f"http://{n.url}/admin/volume/allocate",
@@ -215,6 +292,8 @@ class MasterServer:
         lay.set_writable(vid, True)
 
     async def h_lookup(self, req: web.Request) -> web.Response:
+        if not self.is_leader:
+            return await self._proxy_to_leader(req)
         q = req.query
         vid_s = q.get("volumeId", "") or q.get("fileId", "")
         if "," in vid_s:
@@ -258,6 +337,8 @@ class MasterServer:
 
     async def h_volumes(self, req: web.Request) -> web.Response:
         """VolumeList analog: every volume + EC shard set with locations."""
+        if not self.is_leader:
+            return await self._proxy_to_leader(req)
         out = []
         for node in self.topo.all_nodes():
             out.append({
@@ -273,6 +354,8 @@ class MasterServer:
 
     async def h_ec_lookup(self, req: web.Request) -> web.Response:
         """vid -> {shard_id: [urls]} (LookupEcVolume, topology_ec.go:97-133)."""
+        if not self.is_leader:
+            return await self._proxy_to_leader(req)
         vid = int(req.query["volumeId"])
         by_shard = self.topo.ec_shard_locations.get(vid)
         if not by_shard:
@@ -285,9 +368,14 @@ class MasterServer:
 
     async def h_cluster_status(self, req: web.Request) -> web.Response:
         return web.json_response({
-            "isLeader": True, "leader": self.url, "peers": []})
+            "isLeader": self.is_leader,
+            "leader": self.leader_url or "",
+            "term": self.election.term if self.election else 0,
+            "peers": self._peers})
 
     async def h_grow(self, req: web.Request) -> web.Response:
+        if not self.is_leader:
+            return await self._proxy_to_leader(req)
         q = req.query
         collection = q.get("collection", "")
         replication = q.get("replication", "") or self.default_replication
@@ -307,6 +395,8 @@ class MasterServer:
         return web.json_response({"count": grown})
 
     async def h_collection_delete(self, req: web.Request) -> web.Response:
+        if not self.is_leader:
+            return await self._proxy_to_leader(req)
         collection = req.query.get("collection", "")
         deleted = []
         for node in self.topo.all_nodes():
@@ -327,6 +417,16 @@ class MasterServer:
             q.put_nowait(update)
 
     async def h_watch(self, req: web.Request) -> web.StreamResponse:
+        if not self.is_leader:
+            # a follower has no topology; hand the subscriber the leader
+            # hint (wdclient reconnects there, masterclient.py:158-162)
+            resp = web.StreamResponse(
+                headers={"Content-Type": "application/x-ndjson"})
+            await resp.prepare(req)
+            await resp.write(json.dumps(
+                {"leader": self.leader_url or ""}).encode() + b"\n")
+            await resp.write_eof()
+            return resp
         resp = web.StreamResponse(
             headers={"Content-Type": "application/x-ndjson"})
         await resp.prepare(req)
@@ -348,6 +448,13 @@ class MasterServer:
             # their map is complete (KeepConnected's initial sync boundary)
             await resp.write(b'{"synced": true}\n')
             while True:
+                if not self.is_leader:
+                    # deposed mid-stream: this master no longer receives
+                    # heartbeats, so the subscriber's map would silently
+                    # go stale; redirect it to the new leader
+                    await resp.write(json.dumps(
+                        {"leader": self.leader_url or ""}).encode() + b"\n")
+                    break
                 try:
                     update = await asyncio.wait_for(q.get(), timeout=1.0)
                 except asyncio.TimeoutError:

@@ -36,7 +36,12 @@ class VolumeServer:
                  jwt_key: str = ""):
         self.jwt_key = jwt_key
         self.store = store
-        self.master_url = master_url
+        # comma-separated seed list: chase the leader hint, rotate seeds on
+        # total failure (volume_grpc_client_to_master.go:33-53)
+        self.master_seeds = [m.strip() for m in master_url.split(",")
+                             if m.strip()]
+        self.master_url = self.master_seeds[0]
+        self._seed_idx = 0
         self.ip = ip
         self.port = port
         self.data_center = data_center
@@ -149,6 +154,14 @@ class VolumeServer:
 
     # ---- heartbeat loop ----
 
+    def _requeue_deltas(self, hb) -> None:
+        """Put consumed heartbeat deltas back so they reach the master on
+        the next successful pulse."""
+        self.store.new_volumes.extend(hb.new_volumes)
+        self.store.deleted_volumes.extend(hb.deleted_volumes)
+        self.store.new_ec_shards.extend(hb.new_ec_shards)
+        self.store.deleted_ec_shards.extend(hb.deleted_ec_shards)
+
     async def heartbeat_once(self) -> None:
         from ..stats import metrics
         if metrics.HAVE_PROMETHEUS:
@@ -160,16 +173,22 @@ class VolumeServer:
                     json=hb.to_dict()) as resp:
                 body = await resp.json()
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
-            # re-queue the consumed deltas so they reach the master when
-            # connectivity returns
-            self.store.new_volumes.extend(hb.new_volumes)
-            self.store.deleted_volumes.extend(hb.deleted_volumes)
-            self.store.new_ec_shards.extend(hb.new_ec_shards)
-            self.store.deleted_ec_shards.extend(hb.deleted_ec_shards)
+            self._requeue_deltas(hb)
             raise
+        leader = body.get("leader")
+        if body.get("rejected"):
+            # a follower master refused registration: requeue deltas and
+            # chase the leader it pointed at
+            self._requeue_deltas(hb)
+            if leader:
+                self.master_url = leader
+                return
+            # rejected with no leader known: treat as failure so the
+            # heartbeat loop rotates to another seed master
+            raise OSError(
+                f"master {self.master_url} rejected heartbeat, no leader")
         self.volume_size_limit = body.get(
             "volume_size_limit", self.volume_size_limit)
-        leader = body.get("leader")
         if leader and leader != self.master_url:
             self.master_url = leader
 
@@ -178,7 +197,12 @@ class VolumeServer:
             try:
                 await self.heartbeat_once()
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
-                pass
+                # current master unreachable: rotate through seed masters
+                # (with one seed this still resets master_url back to the
+                # configured seed after a learned leader dies)
+                self._seed_idx = (self._seed_idx + 1) \
+                    % len(self.master_seeds)
+                self.master_url = self.master_seeds[self._seed_idx]
             await asyncio.sleep(self.pulse_seconds)
 
     # ---- public needle handlers ----

@@ -1,0 +1,197 @@
+"""Multi-master leader election (raft_server.go analog) integration tests."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+
+import aiohttp
+
+from cluster_util import run
+
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.server.volume_server import VolumeServer
+from seaweedfs_tpu.storage.store import Store
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def _make_cluster(n: int = 3) -> list[MasterServer]:
+    ports = _free_ports(n)
+    urls = [f"127.0.0.1:{p}" for p in ports]
+    masters = []
+    for p in ports:
+        m = MasterServer(port=p, pulse_seconds=0.1,
+                         peers=urls,
+                         election_timeout=(0.15, 0.35),
+                         election_pulse=0.05)
+        await m.start()
+        masters.append(m)
+    return masters
+
+
+async def _wait_single_leader(masters, timeout: float = 5.0) -> MasterServer:
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        leaders = [m for m in masters if m.is_leader]
+        agreed = {m.leader_url for m in masters}
+        if len(leaders) == 1 and agreed == {leaders[0].url}:
+            return leaders[0]
+        await asyncio.sleep(0.05)
+    raise AssertionError(
+        f"no stable leader: roles={[m.election.role for m in masters]}")
+
+
+def test_single_leader_elected_and_agreed():
+    run(_body_single_leader())
+
+
+async def _body_single_leader():
+    masters = await _make_cluster(3)
+    try:
+        leader = await _wait_single_leader(masters)
+        for m in masters:
+            assert m.leader_url == leader.url
+        terms = {m.election.term for m in masters}
+        assert len(terms) == 1
+    finally:
+        for m in masters:
+            await m.stop()
+
+
+def test_follower_proxies_assign_and_status(tmp_path):
+    run(_body_proxy(tmp_path))
+
+
+async def _body_proxy(tmp_path):
+    masters = await _make_cluster(3)
+    vs = None
+    try:
+        leader = await _wait_single_leader(masters)
+        follower = next(m for m in masters if not m.is_leader)
+
+        store = Store([os.path.join(str(tmp_path), "v0")],
+                      max_volume_counts=[8])
+        # point the volume server at a follower: the rejected heartbeat
+        # must redirect it to the leader
+        vs = VolumeServer(store, follower.url, port=0, pulse_seconds=0.1)
+        await vs.start()
+        await vs.heartbeat_once()   # rejected, learns leader
+        assert vs.master_url == leader.url
+        await vs.heartbeat_once()   # registers with leader
+
+        async with aiohttp.ClientSession() as http:
+            async with http.get(
+                    f"http://{follower.url}/cluster/status") as resp:
+                st = await resp.json()
+            assert st["isLeader"] is False
+            assert st["leader"] == leader.url
+            # assign via follower is proxied to the leader
+            async with http.post(
+                    f"http://{follower.url}/dir/assign") as resp:
+                body = await resp.json()
+            assert resp.status == 200, body
+            assert "fid" in body, body
+    finally:
+        if vs:
+            await vs.stop()
+        for m in masters:
+            await m.stop()
+
+
+def test_leader_steps_down_without_quorum():
+    run(_body_quorum_loss())
+
+
+async def _body_quorum_loss():
+    masters = await _make_cluster(3)
+    try:
+        leader = await _wait_single_leader(masters)
+        followers = [m for m in masters if m is not leader]
+        for f in followers:
+            await f.stop()
+        # partitioned from every peer, the leader must drop its lease
+        # instead of keeping a second writable master alive
+        deadline = asyncio.get_event_loop().time() + 3.0
+        while asyncio.get_event_loop().time() < deadline:
+            if not leader.is_leader:
+                break
+            await asyncio.sleep(0.05)
+        assert not leader.is_leader
+        # and writes through it are refused, not misapplied
+        async with aiohttp.ClientSession() as http:
+            async with http.post(
+                    f"http://{leader.url}/dir/assign") as resp:
+                assert resp.status == 503
+    finally:
+        for m in masters:
+            await m.stop()
+
+
+def test_leader_failover_and_max_volume_id_survives(tmp_path):
+    run(_body_failover(tmp_path))
+
+
+async def _body_failover(tmp_path):
+    masters = await _make_cluster(3)
+    vs = None
+    try:
+        leader = await _wait_single_leader(masters)
+        survivors = [m for m in masters if m is not leader]
+
+        seeds = ",".join(m.url for m in masters)
+        store = Store([os.path.join(str(tmp_path), "v0")],
+                      max_volume_counts=[8])
+        vs = VolumeServer(store, seeds, port=0, pulse_seconds=0.1)
+        await vs.start()
+        for _ in range(4):
+            await vs.heartbeat_once()
+        assert vs.master_url == leader.url
+
+        # grow a volume so MaxVolumeId advances on the leader, then verify
+        # the replicated value reached followers via leader pulses
+        async with aiohttp.ClientSession() as http:
+            async with http.post(f"http://{leader.url}/dir/assign") as resp:
+                assert (await resp.json()).get("fid")
+        await asyncio.sleep(0.3)
+        grown_vid = leader.topo.max_volume_id
+        assert grown_vid >= 1
+        for m in survivors:
+            assert m.topo.max_volume_id >= grown_vid
+
+        await leader.stop()
+        new_leader = await _wait_single_leader(survivors)
+        assert new_leader.url != leader.url
+        assert new_leader.election.term > leader.election.term
+        # the new leader must not reissue already-used volume ids
+        assert new_leader.topo.max_volume_id >= grown_vid
+
+        # volume server finds the new leader via seed rotation + hint
+        for _ in range(30):
+            try:
+                await vs.heartbeat_once()
+            except Exception:
+                vs._seed_idx = (vs._seed_idx + 1) % len(vs.master_seeds)
+                vs.master_url = vs.master_seeds[vs._seed_idx]
+            if vs.master_url == new_leader.url \
+                    and new_leader.topo.all_nodes():
+                break
+            await asyncio.sleep(0.05)
+        assert vs.master_url == new_leader.url
+        assert any(n.url == vs.url for n in new_leader.topo.all_nodes())
+    finally:
+        if vs:
+            await vs.stop()
+        for m in masters:
+            await m.stop()
