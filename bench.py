@@ -14,6 +14,7 @@ vs_baseline= ratio vs the host CPU encoder measured in the same run (the
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -67,15 +68,19 @@ def _chained_gbs(consts, words, n: int, chain_len: int, rtt: float) -> float:
 
 def bench_tpu(n_bytes_per_shard: int = 64 << 20, chain_len: int = 16) -> dict:
     import jax
+    import jax.numpy as jnp
 
     from seaweedfs_tpu.ec import gf
 
     n = n_bytes_per_shard
     k = gf.DATA_SHARDS
-    rng = np.random.default_rng(0)
-    words = [jax.device_put(rng.integers(0, 2**32, (n // 512, 128),
-                                         dtype=np.uint32))
-             for _ in range(k)]
+    # generate the stripes ON DEVICE: a device_put of 640MB through the
+    # axon tunnel takes minutes, while PRNG keys are a few bytes
+    make = jax.jit(
+        lambda key: jax.random.bits(key, (n // 512, 128), jnp.uint32))
+    keys = jax.random.split(jax.random.PRNGKey(0), k)
+    words = [make(keys[i]) for i in range(k)]
+    jax.block_until_ready(words)
     rtt = _roundtrip_latency()
 
     enc_consts = gf.bitplane_constants(gf.parity_matrix())
@@ -92,14 +97,17 @@ def bench_tpu(n_bytes_per_shard: int = 64 << 20, chain_len: int = 16) -> dict:
             "value": min(gbs_enc, gbs_reb)}
 
 
-def bench_cpu(n_bytes_per_shard: int = 4 << 20) -> float:
-    """Host-baseline: numpy table-lookup encoder (the process-local analog
-    of the reference's reedsolomon CPU path)."""
+def bench_cpu(n_bytes_per_shard: int = 4 << 20) -> tuple[float, str]:
+    """Host-baseline: the best available CPU encoder — the native AVX2
+    kernel (native/gf256.c, the analog of the reference's reedsolomon
+    assembly path) when built, else the numpy table-lookup fallback."""
     from seaweedfs_tpu.ec import gf
     from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
 
     enc = CpuEncoder()
-    data = [np.zeros(n_bytes_per_shard, np.uint8)
+    kind = "native-avx2" if enc.use_native else "numpy"
+    rng = np.random.default_rng(7)
+    data = [rng.integers(0, 256, n_bytes_per_shard).astype(np.uint8)
             for _ in range(gf.DATA_SHARDS)]
     enc.encode(list(data))  # warm tables
     t0 = time.perf_counter()
@@ -107,18 +115,24 @@ def bench_cpu(n_bytes_per_shard: int = 4 << 20) -> float:
     for _ in range(iters):
         enc.encode(list(data))
     dt = (time.perf_counter() - t0) / iters
-    return gf.DATA_SHARDS * n_bytes_per_shard / dt / 1e9
+    return gf.DATA_SHARDS * n_bytes_per_shard / dt / 1e9, kind
 
 
 def main() -> None:
     import jax
 
+    # the axon sitecustomize force-registers the TPU tunnel regardless of
+    # JAX_PLATFORMS in the environment; honor an explicit cpu request via
+    # jax.config, which wins because it is read at backend-init time
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     backend = jax.default_backend()
-    cpu_gbs = bench_cpu()
+    cpu_gbs, cpu_kind = bench_cpu()
+    n_env = os.environ.get("SWTPU_BENCH_BYTES")
     if backend == "tpu":
-        tpu = bench_tpu()
+        tpu = bench_tpu(int(n_env) if n_env else 64 << 20)
     else:  # no chip attached: measure the interpret path on tiny shapes
-        tpu = bench_tpu(1 << 20, chain_len=2)
+        tpu = bench_tpu(int(n_env) if n_env else 256 << 10, chain_len=1)
     value = tpu["value"]
     try:
         from seaweedfs_tpu.stats import metrics
@@ -134,6 +148,7 @@ def main() -> None:
         "encode_GBps": round(tpu["encode_gbs"], 2),
         "rebuild4_GBps": round(tpu["rebuild4_gbs"], 2),
         "cpu_baseline_GBps": round(cpu_gbs, 3),
+        "cpu_baseline_kind": cpu_kind,
         "backend": backend,
     }))
 

@@ -22,10 +22,17 @@ class CpuEncoder:
     """
 
     def __init__(self, data_shards: int = gf.DATA_SHARDS,
-                 parity_shards: int = gf.PARITY_SHARDS):
+                 parity_shards: int = gf.PARITY_SHARDS,
+                 use_native: bool | None = None):
         self.k = data_shards
         self.m = parity_shards
         self.n = data_shards + parity_shards
+        # native C kernel (AVX2 PSHUFB, native/gf256.c) when built; the
+        # numpy path stays as the always-available correctness oracle
+        if use_native is None:
+            from ..native import gf256 as _native
+            use_native = _native.available()
+        self.use_native = use_native
         # Copy out of the lru_cache so instance mutation can't poison the
         # process-global matrix shared with every other encoder.
         self.matrix = gf.rs_matrix(self.k, self.n).copy()
@@ -33,8 +40,16 @@ class CpuEncoder:
 
     # -- core matmul ------------------------------------------------------
 
+    def _apply(self, coeff: np.ndarray,
+               inputs: list[np.ndarray]) -> list[np.ndarray]:
+        if self.use_native and inputs and inputs[0].ndim == 1:
+            from ..native import gf256 as _native
+            return _native.transform(coeff, inputs)
+        return self._apply_numpy(coeff, inputs)
+
     @staticmethod
-    def _apply(coeff: np.ndarray, inputs: list[np.ndarray]) -> list[np.ndarray]:
+    def _apply_numpy(coeff: np.ndarray,
+                     inputs: list[np.ndarray]) -> list[np.ndarray]:
         """rows_out[r] = XOR_i mul_table(coeff[r,i])[inputs[i]]."""
         rows, k = coeff.shape
         assert k == len(inputs)

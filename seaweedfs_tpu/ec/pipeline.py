@@ -56,10 +56,13 @@ def _transform_buffers(encoder, coeff: np.ndarray,
         consts = gf.bitplane_constants(coeff)
         outs = gf256_words_transform(consts, words)
         return [words_to_bytes(np.asarray(o), n).copy() for o in outs]
-    # CPU path: plain table-lookup matmul
+    # CPU path: native AVX2 kernel when built, numpy table lookup otherwise
     from .encoder_cpu import CpuEncoder
-    return CpuEncoder._apply(np.asarray(coeff, np.uint8),
-                             [np.asarray(b, np.uint8) for b in buffers])
+    if isinstance(encoder, CpuEncoder):
+        return encoder._apply(np.asarray(coeff, np.uint8),
+                              [np.asarray(b, np.uint8) for b in buffers])
+    return CpuEncoder._apply_numpy(np.asarray(coeff, np.uint8),
+                                   [np.asarray(b, np.uint8) for b in buffers])
 
 
 def write_ec_files(base_name: str, encoder=None,

@@ -15,7 +15,8 @@ import threading
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libswtpu_native.so")
 _SOURCES = [os.path.join(_DIR, "crc32c.c"),
-            os.path.join(_DIR, "needle_map.c")]
+            os.path.join(_DIR, "needle_map.c"),
+            os.path.join(_DIR, "gf256.c")]
 _lock = threading.Lock()
 _lib = None
 _failed = False
@@ -40,6 +41,9 @@ def load() -> ctypes.CDLL | None:
         try:
             if _needs_build():
                 srcs = [s for s in _SOURCES if os.path.exists(s)]
+                # no -mavx2 etc: SIMD paths use per-function target
+                # attributes + __builtin_cpu_supports runtime dispatch, so
+                # a cached .so stays safe on a different host
                 cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _SO] + srcs
                 subprocess.run(cmd, check=True, capture_output=True,
                                cwd=_DIR, timeout=120)
@@ -47,6 +51,18 @@ def load() -> ctypes.CDLL | None:
             lib.swtpu_crc32c.restype = ctypes.c_uint32
             lib.swtpu_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_char_p,
                                          ctypes.c_size_t]
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            for fname in ("swtpu_gf256_transform",
+                          "swtpu_gf256_transform_scalar"):
+                fn = getattr(lib, fname)
+                fn.restype = None
+                fn.argtypes = [u8p, ctypes.c_int, ctypes.c_int,
+                               ctypes.POINTER(u8p), ctypes.POINTER(u8p),
+                               ctypes.c_size_t]
+            # build the GF tables now, single-threaded under _lock: the
+            # transforms run GIL-free and must never race a lazy init
+            lib.swtpu_gf256_init.restype = None
+            lib.swtpu_gf256_init()
             _lib = lib
         except Exception:
             _failed = True

@@ -16,6 +16,13 @@
 #define HAVE_SSE42_INTRIN 1
 #endif
 
+/* built with g++: exported symbols must not be C++-mangled or the ctypes
+ * lookup in build.py fails and the whole library silently degrades to the
+ * pure-Python fallbacks */
+#ifdef __cplusplus
+extern "C" {
+#endif
+
 #define POLY 0x82f63b78u /* reflected Castagnoli */
 
 static uint32_t table[8][256];
@@ -88,3 +95,7 @@ uint32_t swtpu_crc32c(uint32_t crc, const uint8_t *buf, size_t len) {
 #endif
     return crc32c_sw(crc, buf, len);
 }
+
+#ifdef __cplusplus
+}
+#endif
