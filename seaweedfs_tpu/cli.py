@@ -6,6 +6,8 @@ command/command.go:10-30). Run as `python -m seaweedfs_tpu.cli <cmd>`.
 
 from __future__ import annotations
 
+from .security import tls
+
 import argparse
 import asyncio
 import json
@@ -18,6 +20,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("-ip", default="127.0.0.1")
     p.add_argument("-master", default="127.0.0.1:9333",
                    help="master host:port")
+    p.add_argument("-v", type=int, default=0, dest="verbosity",
+                   help="glog verbose level (V(n) guards)")
+    p.add_argument("-logdir", default="",
+                   help="write per-severity rotated log files here")
+    p.add_argument("-logtostderr", default=True,
+                   type=lambda s: s.lower() not in ("false", "0", "no"),
+                   help="also log to stderr (set false with -logdir for "
+                        "file-only logging)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -66,6 +76,15 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-notify", default="",
                    help="publish meta changes: file:<path> | sqlite:<path> "
                         "| log")
+
+    fc = sub.add_parser("filer.copy",
+                        help="parallel-upload local files/trees to a filer")
+    fc.add_argument("paths", nargs="+",
+                    help="local files or directories, then the target "
+                         "http://filer:port/dir/ URL last")
+    fc.add_argument("-concurrency", type=int, default=8)
+    fc.add_argument("-include", default="",
+                    help="only copy names matching this glob (e.g. *.txt)")
 
     fr = sub.add_parser("filer.replicate",
                         help="replay filer meta events into a sink")
@@ -278,6 +297,78 @@ def _make_sink(spec: str, sink_dir: str):
     raise SystemExit(f"unknown sink kind {kind!r}")
 
 
+async def _run_filer_copy(args) -> None:
+    """Parallel tree upload to the filer HTTP surface
+    (reference: weed filer.copy, command/filer_copy.go)."""
+    import fnmatch
+
+    import aiohttp
+
+    *sources, dest = args.paths
+    if not dest.startswith("http"):
+        raise SystemExit("last argument must be the target "
+                         "http://filer:port/dir/ URL")
+    if not dest.endswith("/"):
+        dest += "/"
+
+    jobs: list[tuple[str, str]] = []  # (local path, remote rel path)
+    for src in sources:
+        if os.path.isdir(src):
+            base = os.path.basename(os.path.abspath(src))
+            for root, _, files in os.walk(src):
+                for name in files:
+                    if args.include and not fnmatch.fnmatch(name,
+                                                            args.include):
+                        continue
+                    full = os.path.join(root, name)
+                    rel = os.path.join(base,
+                                       os.path.relpath(full, src))
+                    jobs.append((full, rel))
+        elif os.path.isfile(src):
+            if not args.include or fnmatch.fnmatch(
+                    os.path.basename(src), args.include):
+                jobs.append((src, os.path.basename(src)))
+        else:
+            raise SystemExit(f"no such file or directory: {src}")
+
+    import urllib.parse
+
+    sem = asyncio.Semaphore(args.concurrency)
+    copied = errors = 0
+
+    async with tls.make_session() as http:
+        async def upload(local: str, rel: str) -> bool:
+            async with sem:
+                try:
+                    # hand the file object to FormData so aiohttp streams
+                    # it instead of holding whole files in memory
+                    with open(local, "rb") as f:
+                        form = aiohttp.FormData()
+                        form.add_field("file", f,
+                                       filename=os.path.basename(rel))
+                        target = dest + urllib.parse.quote(
+                            rel.replace(os.sep, "/"))
+                        async with http.post(target, data=form) as resp:
+                            if resp.status not in (200, 201):
+                                print(f"copy {local}: http {resp.status} "
+                                      f"{await resp.text()}")
+                                return False
+                except (OSError, aiohttp.ClientError,
+                        asyncio.TimeoutError) as e:
+                    print(f"copy {local}: {e}")
+                    return False
+            return True
+
+        results = await asyncio.gather(
+            *(upload(l, r) for l, r in jobs))
+        copied = sum(results)
+        errors = len(results) - copied
+    print(f"copied {copied} files to {dest}"
+          + (f", {errors} errors" if errors else ""))
+    if errors:
+        raise SystemExit(1)
+
+
 async def _run_filer_replicate(args) -> None:
     from .replication.replicator import Replicator
     from .replication.runner import replicate_from_queue
@@ -467,10 +558,10 @@ async def _run_backup(args) -> None:
     from .storage import volume_backup as vb
     from .storage.volume import Volume
 
-    async with aiohttp.ClientSession(
+    async with tls.make_session(
             timeout=aiohttp.ClientTimeout(total=300)) as http:
         async with http.get(
-                f"http://{args.server}/admin/volume/status",
+                tls.url(args.server, "/admin/volume/status"),
                 params={"volume": str(args.volumeId)}) as resp:
             if resp.status != 200:
                 print(f"volume {args.volumeId} not found on {args.server}")
@@ -500,7 +591,7 @@ async def _run_backup(args) -> None:
                 for ext in (".idx", ".dat"):
                     tmp = base + ext + ".tmp"
                     async with http.get(
-                            f"http://{args.server}/admin/file",
+                            tls.url(args.server, "/admin/file"),
                             params={"volume": str(args.volumeId),
                                     "collection": collection,
                                     "ext": ext}) as resp:
@@ -532,7 +623,7 @@ async def _run_backup(args) -> None:
             applied = 0
             dec = vb.FrameDecoder()
             async with http.get(
-                    f"http://{args.server}/admin/volume/tail",
+                    tls.url(args.server, "/admin/volume/tail"),
                     params={"volume": str(args.volumeId),
                             "since_ns": str(since)}) as resp:
                 if resp.status != 200:
@@ -603,6 +694,14 @@ _SCAFFOLDS = {
 [jwt.signing]
 key = ""            # base64 or raw secret; empty disables write tokens
 expires_after_seconds = 10
+
+[tls]
+# mutual TLS for ALL inter-server traffic (reference: security.toml
+# [grpc.*] sections, weed/security/tls.go). All three paths required.
+ca = ""             # CA certificate that signed every server cert
+cert = ""           # this process's certificate
+key = ""            # this process's private key
+require_client_cert = true
 """,
     "master": """# master.toml
 [master.maintenance]
@@ -626,8 +725,26 @@ path = "./filer.db"
 }
 
 
+def _discover_security_toml() -> None:
+    """viper-style config discovery: ./, ~/.seaweedfs/, /etc/seaweedfs/
+    (util/config.go:28-45). Enables mTLS when [tls] is configured."""
+    for d in (".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"):
+        path = os.path.join(d, "security.toml")
+        if os.path.exists(path):
+            from .util import glog
+            if tls.configure_from_toml(path):
+                glog.info("mTLS enabled from %s", path)
+            return
+
+
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
+    if hasattr(args, "verbosity"):
+        from .util import glog
+        glog.init(verbosity=args.verbosity,
+                  log_dir=args.logdir or None,
+                  logtostderr=args.logtostderr)
+    _discover_security_toml()
     if args.cmd == "version":
         from . import __version__
         print(f"seaweedfs_tpu {__version__}")
@@ -668,6 +785,7 @@ def main(argv: list[str] | None = None) -> None:
         "download": _run_download, "shell": _run_shell,
         "benchmark": _run_benchmark, "backup": _run_backup,
         "webdav": _run_webdav, "filer.replicate": _run_filer_replicate,
+        "filer.copy": _run_filer_copy,
     }
     try:
         asyncio.run(runners[args.cmd](args))

@@ -22,6 +22,7 @@ from typing import Callable
 from ..storage import types as t
 from ..storage.needle import Needle
 from ..storage.needle_map import SortedFileNeedleMap
+from ..util import glog
 from . import gf
 from .locate import (LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, Interval,
                      locate_data)
@@ -138,6 +139,8 @@ class EcVolume:
             raise EcVolumeError(
                 f"cannot recover shard {want_sid}: only {len(rows)} "
                 f"sources available")
+        glog.V(3).infof("ec recover vid=%d shard=%d off=%d size=%d from %s",
+                        self.vid, want_sid, offset, size, rows)
         coeff = gf.shard_rows([want_sid], rows)
         out = _transform_buffers(self.encoder(), coeff, bufs)
         return np.asarray(out[0], np.uint8).tobytes()

@@ -13,11 +13,15 @@ replicated log: the single replicated value rides on the leader pulse.
 
 from __future__ import annotations
 
+from ..security import tls
+
 import asyncio
 import random
 import time
 
 import aiohttp
+
+from ..util import glog
 
 
 class Election:
@@ -64,7 +68,7 @@ class Election:
     async def start(self) -> None:
         if self.single:
             return
-        self._http = aiohttp.ClientSession(
+        self._http = tls.make_session(
             timeout=aiohttp.ClientTimeout(total=max(self.pulse * 2, 0.5)))
         self.last_pulse = time.monotonic()
         self._task = asyncio.create_task(self._loop())
@@ -125,6 +129,8 @@ class Election:
 
     def _step_down(self) -> None:
         if self.role != self.FOLLOWER:
+            glog.info("%s: stepping down from %s at term %d",
+                      self.me, self.role, self.term)
             self.role = self.FOLLOWER
 
     # ---- the election / heartbeat loop ----
@@ -159,7 +165,7 @@ class Election:
         async def ask(peer: str) -> bool:
             try:
                 async with self._http.post(
-                        f"http://{peer}/raft/vote",
+                        tls.url(peer, "/raft/vote"),
                         json={"term": term, "candidate": self.me,
                               "max_volume_id": self.get_max_volume_id()},
                 ) as resp:
@@ -176,6 +182,8 @@ class Election:
         votes += sum(results)
         if self.role == self.CANDIDATE and self.term == term \
                 and votes >= self.majority:
+            glog.info("%s: elected leader at term %d (%d/%d votes)",
+                      self.me, term, votes, len(self.peers) + 1)
             self.role = self.LEADER
             self.leader = self.me
             self._last_quorum = time.monotonic()
@@ -192,7 +200,7 @@ class Election:
         async def send(peer: str) -> bool:
             try:
                 async with self._http.post(
-                        f"http://{peer}/raft/heartbeat", json=body) as resp:
+                        tls.url(peer, "/raft/heartbeat"), json=body) as resp:
                     reply = await resp.json()
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
                 return False

@@ -22,6 +22,7 @@ from ..storage.super_block import ReplicaPlacement
 from ..topology.layout import (LayoutKey, PlacementError, VolumeLayout,
                                find_empty_slots)
 from ..topology.tree import DataNode, Topology
+from ..security import tls
 from .election import Election
 from .sequence import MemorySequencer
 
@@ -81,11 +82,12 @@ class MasterServer:
         return f"{self.ip}:{self.port}"
 
     async def start(self) -> None:
-        self._http = aiohttp.ClientSession(
+        self._http = tls.make_session(
             timeout=aiohttp.ClientTimeout(total=30))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        self._site = web.TCPSite(self._runner, self.ip, self.port)
+        self._site = web.TCPSite(self._runner, self.ip, self.port,
+                            ssl_context=tls.server_ctx())
         await self._site.start()
         if self.port == 0:
             self.port = self._site._server.sockets[0].getsockname()[1]
@@ -167,7 +169,7 @@ class MasterServer:
         data = await req.read()
         try:
             async with self._http.request(
-                    req.method, f"http://{leader}{req.path_qs}",
+                    req.method, tls.url(leader, f"{req.path_qs}"),
                     data=data or None) as resp:
                 return web.Response(body=await resp.read(),
                                     status=resp.status,
@@ -276,7 +278,7 @@ class MasterServer:
                 f"vid {vid}: MaxVolumeId not replicated to a quorum")
         for n in nodes:
             async with self._http.post(
-                    f"http://{n.url}/admin/volume/allocate",
+                    tls.url(n.url, "/admin/volume/allocate"),
                     params={"volume": str(vid), "collection": collection,
                             "replication": replication, "ttl": ttl}) as resp:
                 if resp.status != 200:
@@ -404,7 +406,7 @@ class MasterServer:
                     if m.collection == collection]
             for vid in vids:
                 async with self._http.post(
-                        f"http://{node.url}/admin/volume/delete",
+                        tls.url(node.url, "/admin/volume/delete"),
                         params={"volume": str(vid)}) as resp:
                     await resp.read()
                 deleted.append(vid)

@@ -9,6 +9,8 @@ b2sink).
 
 from __future__ import annotations
 
+from ..security import tls
+
 import asyncio
 import os
 
@@ -86,7 +88,7 @@ class FilerSink(ReplicationSink):
     async def start(self) -> None:
         self._client = WeedClient(self.master_url)
         await self._client.__aenter__()
-        self._http = aiohttp.ClientSession(
+        self._http = tls.make_session(
             timeout=aiohttp.ClientTimeout(total=60))
 
     async def close(self) -> None:
@@ -108,7 +110,7 @@ class FilerSink(ReplicationSink):
 
     async def _find(self, key: str) -> Entry | None:
         async with self._http.get(
-                f"http://{self.filer_url}/__api__/lookup",
+                tls.url(self.filer_url, "/__api__/lookup"),
                 params={"path": key}) as resp:
             if resp.status != 200:
                 return None
@@ -127,7 +129,7 @@ class FilerSink(ReplicationSink):
             "extended": entry.extended,
         }
         async with self._http.post(
-                f"http://{self.filer_url}/__api__/entry",
+                tls.url(self.filer_url, "/__api__/entry"),
                 json=payload) as resp:
             if resp.status != 200:
                 raise RuntimeError(
@@ -158,7 +160,7 @@ class FilerSink(ReplicationSink):
                            delete_chunks: bool) -> None:
         params = {"recursive": "true"} if is_directory else {}
         async with self._http.delete(
-                f"http://{self.filer_url}{key}", params=params) as resp:
+                tls.url(self.filer_url, f"{key}"), params=params) as resp:
             if resp.status not in (200, 204, 404):
                 raise RuntimeError(
                     f"filer sink delete {key}: {resp.status}")
@@ -182,7 +184,7 @@ class S3Sink(ReplicationSink):
         return self.directory
 
     async def start(self) -> None:
-        self._http = aiohttp.ClientSession(
+        self._http = tls.make_session(
             timeout=aiohttp.ClientTimeout(total=60))
         async with self._http.put(
                 f"{self.endpoint}/{self.bucket}") as resp:

@@ -28,6 +28,7 @@ from ..filer.stream import stream_chunk_views
 from ..filer.filer import Filer, FilerError
 from ..util.client import OperationError, WeedClient
 from ..util.httprange import RangeError, parse_range
+from ..security import tls
 
 BUCKETS_DIR = "/buckets"
 UPLOADS_DIR = "/buckets/.uploads"
@@ -88,7 +89,8 @@ class S3Gateway:
             self._gc_task = asyncio.create_task(self._chunk_gc_loop())
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.ip, self.port)
+        site = web.TCPSite(self._runner, self.ip, self.port,
+                            ssl_context=tls.server_ctx())
         await site.start()
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]
@@ -469,7 +471,7 @@ class S3Gateway:
             self.filer.store.delete_entry(updir)
             root = ET.Element("CompleteMultipartUploadResult", xmlns=_NS)
             ET.SubElement(root, "Location").text = \
-                f"http://{self.url}/{bucket}/{key}"
+                tls.url(self.url, f"/{bucket}/{key}")
             ET.SubElement(root, "Bucket").text = bucket
             ET.SubElement(root, "Key").text = key
             ET.SubElement(root, "ETag").text = \

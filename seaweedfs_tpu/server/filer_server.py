@@ -20,6 +20,7 @@ from ..filer.filer import Filer, FilerError
 from ..filer.stream import stream_chunk_views
 from ..util.client import OperationError, WeedClient
 from ..util.httprange import RangeError, parse_range
+from ..security import tls
 
 
 class FilerServer:
@@ -90,7 +91,8 @@ class FilerServer:
         self._pending: list[str] = []
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.ip, self.port)
+        site = web.TCPSite(self._runner, self.ip, self.port,
+                            ssl_context=tls.server_ctx())
         await site.start()
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]

@@ -21,10 +21,12 @@ from aiohttp import web
 from ..ec import gf
 from ..ec import pipeline as ecpl
 from ..pb import messages as pb
+from ..util import glog
 from ..storage import types as t
 from ..storage.needle import FLAG_GZIP, FLAG_HAS_LAST_MODIFIED, CrcMismatch, Needle
 from ..storage.store import Store
 from ..storage.volume import AlreadyDeleted, NotFound, VolumeError
+from ..security import tls
 
 
 class VolumeServer:
@@ -98,11 +100,12 @@ class VolumeServer:
         return f"{self.ip}:{self.port}"
 
     async def start(self) -> None:
-        self._http = aiohttp.ClientSession(
+        self._http = tls.make_session(
             timeout=aiohttp.ClientTimeout(total=60))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.ip, self.port)
+        site = web.TCPSite(self._runner, self.ip, self.port,
+                            ssl_context=tls.server_ctx())
         await site.start()
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]
@@ -130,10 +133,11 @@ class VolumeServer:
         location registry; called from executor threads only."""
         import json as _json
         import urllib.request
+        ctx = tls.client_ctx()
         try:
             with urllib.request.urlopen(
-                    f"http://{self.master_url}/vol/ec_lookup?volumeId={vid}",
-                    timeout=10) as r:
+                    tls.url(self.master_url, f"/vol/ec_lookup?volumeId={vid}"),
+                    timeout=10, context=ctx) as r:
                 shards = _json.load(r)["shards"]
         except Exception:
             return None
@@ -142,9 +146,11 @@ class VolumeServer:
                 continue
             try:
                 with urllib.request.urlopen(
-                        f"http://{target}/admin/ec/shard_read?volume={vid}"
-                        f"&shard={shard_id}&offset={offset}&size={size}",
-                        timeout=30) as r:
+                        tls.url(target,
+                                f"/admin/ec/shard_read?volume={vid}"
+                                f"&shard={shard_id}&offset={offset}"
+                                f"&size={size}"),
+                        timeout=30, context=ctx) as r:
                     data = r.read()
                     if len(data) == size:
                         return data
@@ -169,7 +175,7 @@ class VolumeServer:
         hb = self.store.collect_heartbeat(self.data_center, self.rack)
         try:
             async with self._http.post(
-                    f"http://{self.master_url}/cluster/heartbeat",
+                    tls.url(self.master_url, "/cluster/heartbeat"),
                     json=hb.to_dict()) as resp:
                 body = await resp.json()
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
@@ -190,6 +196,8 @@ class VolumeServer:
         self.volume_size_limit = body.get(
             "volume_size_limit", self.volume_size_limit)
         if leader and leader != self.master_url:
+            glog.info("volume %s: chasing new master leader %s (was %s)",
+                      self.url, leader, self.master_url)
             self.master_url = leader
 
     async def _heartbeat_loop(self) -> None:
@@ -221,7 +229,7 @@ class VolumeServer:
                 return web.json_response({"error": "not found"}, status=404)
             # misrouted read: redirect via master lookup (handlers_read.go:46)
             async with self._http.get(
-                    f"http://{self.master_url}/dir/lookup",
+                    tls.url(self.master_url, "/dir/lookup"),
                     params={"volumeId": str(fid.volume_id)}) as resp:
                 if resp.status != 200:
                     return web.json_response({"error": "volume not found"},
@@ -232,7 +240,7 @@ class VolumeServer:
                 return web.json_response({"error": "volume not found"},
                                          status=404)
             raise web.HTTPMovedPermanently(
-                f"http://{others[0]['publicUrl']}/{req.match_info['fid']}")
+                tls.url(others[0]['publicUrl'], f"/{req.match_info['fid']}"))
         from ..stats import metrics
         try:
             # disk (and possibly remote-shard) I/O: keep off the event loop
@@ -431,7 +439,7 @@ class VolumeServer:
                                    auth: str = "") -> None:
         try:
             async with self._http.get(
-                    f"http://{self.master_url}/vol/ec_lookup",
+                    tls.url(self.master_url, "/vol/ec_lookup"),
                     params={"volumeId": str(vid)}) as resp:
                 if resp.status != 200:
                     return
@@ -445,7 +453,7 @@ class VolumeServer:
         async def one(target: str) -> None:
             try:
                 async with self._http.delete(
-                        f"http://{target}/{fid}",
+                        tls.url(target, f"/{fid}"),
                         params={"type": "replicate"},
                         headers=headers) as r:
                     await r.read()
@@ -462,7 +470,7 @@ class VolumeServer:
         vid = fid.split(",")[0]
         try:
             async with self._http.get(
-                    f"http://{self.master_url}/dir/lookup",
+                    tls.url(self.master_url, "/dir/lookup"),
                     params={"volumeId": vid}) as resp:
                 if resp.status != 200:
                     return False
@@ -477,13 +485,13 @@ class VolumeServer:
             try:
                 if method == "POST":
                     async with self._http.post(
-                            f"http://{target}/{fid}",
+                            tls.url(target, f"/{fid}"),
                             params={"type": "replicate"},
                             data=raw_needle,
                             headers={"X-Raw-Needle": "1", **extra}) as r:
                         return r.status in (200, 201)
                 async with self._http.delete(
-                        f"http://{target}/{fid}",
+                        tls.url(target, f"/{fid}"),
                         params={"type": "replicate"},
                         headers=extra) as r:
                     return r.status == 200
@@ -563,7 +571,7 @@ class VolumeServer:
         async def fetch(ext: str) -> str | None:
             try:
                 async with self._http.get(
-                        f"http://{source}/admin/file",
+                        tls.url(source, "/admin/file"),
                         params={"volume": str(vid), "collection": collection,
                                 "ext": ext}) as resp:
                     if resp.status != 200:
@@ -650,7 +658,7 @@ class VolumeServer:
 
         try:
             async with self._http.get(
-                    f"http://{source}/admin/volume/tail",
+                    tls.url(source, "/admin/volume/tail"),
                     params={"volume": str(vid),
                             "since_ns": str(since)}) as resp:
                 if resp.status != 200:
@@ -825,7 +833,7 @@ class VolumeServer:
         for ext in exts:
             try:
                 async with self._http.get(
-                        f"http://{source}/admin/file",
+                        tls.url(source, "/admin/file"),
                         params={"volume": str(vid),
                                 "collection": collection,
                                 "ext": ext}) as resp:

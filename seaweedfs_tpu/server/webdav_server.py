@@ -25,6 +25,7 @@ from ..filer.stream import stream_chunk_views
 from ..filer.filer import Filer, FilerError
 from ..util.client import OperationError, WeedClient
 from ..util.httprange import RangeError, parse_range
+from ..security import tls
 
 DAV_NS = "DAV:"
 ET.register_namespace("D", DAV_NS)
@@ -73,7 +74,8 @@ class WebDavServer:
         await self.client.__aenter__()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.ip, self.port)
+        site = web.TCPSite(self._runner, self.ip, self.port,
+                            ssl_context=tls.server_ctx())
         await site.start()
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]

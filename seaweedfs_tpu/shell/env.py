@@ -6,6 +6,8 @@ connection used by every command).
 
 from __future__ import annotations
 
+from ..security import tls
+
 import aiohttp
 
 
@@ -18,7 +20,7 @@ class CommandEnv:
 
     async def __aenter__(self) -> "CommandEnv":
         if self._session is None:
-            self._session = aiohttp.ClientSession(
+            self._session = tls.make_session(
                 timeout=aiohttp.ClientTimeout(total=300))
         return self
 
@@ -32,12 +34,12 @@ class CommandEnv:
         return self._session
 
     async def master_get(self, path: str, **params) -> dict:
-        async with self.http.get(f"http://{self.master_url}{path}",
+        async with self.http.get(tls.url(self.master_url, f"{path}"),
                                  params=params) as resp:
             return await resp.json()
 
     async def node_post(self, url: str, path: str, **params) -> dict:
-        async with self.http.post(f"http://{url}{path}",
+        async with self.http.post(tls.url(url, f"{path}"),
                                   params=params) as resp:
             body = await resp.json()
             if resp.status != 200:

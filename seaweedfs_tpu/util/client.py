@@ -7,6 +7,8 @@ weed/wdclient/ (cached master client).
 
 from __future__ import annotations
 
+from ..security import tls
+
 import asyncio
 import time
 
@@ -34,7 +36,7 @@ class WeedClient:
 
     async def __aenter__(self) -> "WeedClient":
         if self._session is None:
-            self._session = aiohttp.ClientSession(
+            self._session = tls.make_session(
                 timeout=aiohttp.ClientTimeout(total=120))
         return self
 
@@ -61,7 +63,7 @@ class WeedClient:
             params["ttl"] = ttl
         if data_center:
             params["dataCenter"] = data_center
-        async with self.http.get(f"http://{self.master_url}/dir/assign",
+        async with self.http.get(tls.url(self.master_url, "/dir/assign"),
                                  params=params) as resp:
             body = await resp.json()
         if "error" in body:
@@ -89,7 +91,7 @@ class WeedClient:
         now = time.time()
         if hit and now - hit[0] < self._cache_ttl:
             return hit[1]
-        async with self.http.get(f"http://{self.master_url}/dir/lookup",
+        async with self.http.get(tls.url(self.master_url, "/dir/lookup"),
                                  params={"volumeId": vid}) as resp:
             body = await resp.json()
         if "locations" not in body:
@@ -103,7 +105,7 @@ class WeedClient:
     async def lookup_file_id(self, fid: str) -> str:
         vid = fid.split(",")[0]
         locs = await self.lookup(vid)
-        return f"http://{locs[0]['publicUrl']}/{fid}"
+        return tls.url(locs[0]['publicUrl'], f"/{fid}")
 
     # ---- data ops ----
 
@@ -121,7 +123,7 @@ class WeedClient:
         token = auth or self._mint_jwt(fid)
         if token:
             headers["Authorization"] = f"Bearer {token}"
-        async with self.http.post(f"http://{url}/{fid}", data=data,
+        async with self.http.post(tls.url(url, f"/{fid}"), data=data,
                                   params=params, headers=headers) as resp:
             body = await resp.json()
             if resp.status not in (200, 201):
@@ -176,7 +178,7 @@ class WeedClient:
                     headers["Authorization"] = f"Bearer {token}"
                 try:
                     async with self.http.delete(
-                            f"http://{server}/{fid}",
+                            tls.url(server, f"/{fid}"),
                             params={"type": "replicate"},
                             headers=headers) as resp:
                         n += resp.status == 200

@@ -14,6 +14,8 @@ moves and node deaths.
 
 from __future__ import annotations
 
+from ..security import tls
+
 import asyncio
 import json
 from dataclasses import dataclass
@@ -50,7 +52,7 @@ class MasterClient:
         if self._session is None:
             # sock_read must outlast the master's 1s keepalive but fire on
             # a silently-dead peer, or failover never triggers
-            self._session = aiohttp.ClientSession(
+            self._session = tls.make_session(
                 timeout=aiohttp.ClientTimeout(total=None, connect=10,
                                               sock_read=5.0))
         self._task = asyncio.create_task(self._keep_connected())
@@ -87,7 +89,7 @@ class MasterClient:
             return None
         i = self._rr.get(vid, 0) % len(locs)
         self._rr[vid] = i + 1
-        return f"http://{locs[i].public_url}/{fid}"
+        return tls.url(locs[i].public_url, f"/{fid}")
 
     @property
     def vid_count(self) -> int:
@@ -137,7 +139,7 @@ class MasterClient:
 
     async def _consume_stream(self, master: str) -> None:
         async with self._session.get(
-                f"http://{master}/cluster/watch") as resp:
+                tls.url(master, "/cluster/watch")) as resp:
             if resp.status != 200:
                 raise RuntimeError(f"watch {master}: {resp.status}")
             # fresh connect: rebuild from the snapshot the stream opens

@@ -41,7 +41,7 @@ async def _make_cluster(n: int = 3) -> list[MasterServer]:
     return masters
 
 
-async def _wait_single_leader(masters, timeout: float = 5.0) -> MasterServer:
+async def _wait_single_leader(masters, timeout: float = 10.0) -> MasterServer:
     deadline = asyncio.get_event_loop().time() + timeout
     while asyncio.get_event_loop().time() < deadline:
         leaders = [m for m in masters if m.is_leader]
@@ -178,7 +178,7 @@ async def _body_failover(tmp_path):
         assert new_leader.topo.max_volume_id >= grown_vid
 
         # volume server finds the new leader via seed rotation + hint
-        for _ in range(30):
+        for _ in range(60):
             try:
                 await vs.heartbeat_once()
             except Exception:
