@@ -28,6 +28,10 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    type=lambda s: s.lower() not in ("false", "0", "no"),
                    help="also log to stderr (set false with -logdir for "
                         "file-only logging)")
+    p.add_argument("-cpuprofile", default="",
+                   help="write cProfile stats here on exit")
+    p.add_argument("-memprofile", default="",
+                   help="write tracemalloc top allocations here on exit")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -47,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(enables leader election)")
     m.add_argument("-metricsGateway", default="",
                    help="prometheus push-gateway host:port")
+    m.add_argument("-sequencer", default="memory",
+                   help="file-id allocator: memory | file:<path> | "
+                        "etcd:<host:port>")
 
     v = sub.add_parser("volume", help="start a volume server")
     _add_common(v)
@@ -209,7 +216,8 @@ async def _run_master(args) -> None:
                      default_replication=args.defaultReplication,
                      pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
                      peers=[p.strip() for p in args.peers.split(",")
-                            if p.strip()])
+                            if p.strip()],
+                     sequencer=args.sequencer)
     await m.start()
     if args.metricsGateway:
         from .stats.metrics import push_loop
@@ -696,8 +704,10 @@ key = ""            # base64 or raw secret; empty disables write tokens
 expires_after_seconds = 10
 
 [tls]
-# mutual TLS for ALL inter-server traffic (reference: security.toml
-# [grpc.*] sections, weed/security/tls.go). All three paths required.
+# mutual TLS for the inter-server mesh (master + volume servers), like the
+# reference's [grpc.*] sections (weed/security/tls.go). Client-facing
+# surfaces (filer HTTP, S3, WebDAV) stay plaintext + JWT so standard
+# clients keep working. All three paths required.
 ca = ""             # CA certificate that signed every server cert
 cert = ""           # this process's certificate
 key = ""            # this process's private key
@@ -744,6 +754,9 @@ def main(argv: list[str] | None = None) -> None:
         glog.init(verbosity=args.verbosity,
                   log_dir=args.logdir or None,
                   logtostderr=args.logtostderr)
+        if args.cpuprofile or args.memprofile:
+            from .util.pprof import setup_profiling
+            setup_profiling(args.cpuprofile, args.memprofile)
     _discover_security_toml()
     if args.cmd == "version":
         from . import __version__

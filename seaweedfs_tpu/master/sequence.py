@@ -4,6 +4,7 @@ memory_sequencer.go; the etcd-backed variant maps to a pluggable subclass).
 
 from __future__ import annotations
 
+import os
 import threading
 
 
@@ -20,6 +21,124 @@ class MemorySequencer:
         with self._lock:
             first = self._counter
             self._counter += count
+            return first
+
+    def set_max(self, seen: int) -> None:
+        with self._lock:
+            if seen + 1 > self._counter:
+                self._counter = seen + 1
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._counter
+
+
+class FileSequencer(MemorySequencer):
+    """Crash-safe batched allocator: checkpoints `counter + step` to a
+    file and only touches disk every `step` allocations.
+
+    The durability model of the reference's EtcdSequencer
+    (etcd_sequencer.go:34-135, batch step 100): after a restart the
+    counter resumes from the checkpoint, which is always >= any id ever
+    handed out, so ids are never reissued (a gap of up to `step` ids is
+    the accepted cost).
+    """
+
+    def __init__(self, path: str, step: int = 100):
+        self.path = path
+        self.step = step
+        start = 1
+        if os.path.exists(path):
+            # a corrupt checkpoint must be fatal: silently restarting at 1
+            # would reissue every id ever handed out and overwrite needles
+            try:
+                with open(path) as f:
+                    start = int(f.read().strip())
+            except (OSError, ValueError) as e:
+                raise SystemExit(
+                    f"sequencer checkpoint {path} unreadable/corrupt: {e}; "
+                    f"repair or remove it explicitly") from e
+        super().__init__(start)
+        self._ceiling = start  # all ids < ceiling are checkpointed as used
+
+    def _reserve_locked(self, need: int) -> None:
+        """Ensure the checkpoint covers all ids < max(need, counter)+1;
+        only writes when the counter crosses the ceiling — i.e. once per
+        `step` allocations, not per call."""
+        if need > self._ceiling:
+            self._ceiling = need + self.step
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(self._ceiling))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            first = self._counter
+            self._counter += count
+            self._reserve_locked(self._counter)
+            return first
+
+    def set_max(self, seen: int) -> None:
+        with self._lock:
+            if seen + 1 > self._counter:
+                self._counter = seen + 1
+                self._reserve_locked(self._counter)
+
+
+class EtcdSequencer:  # pragma: no cover - driver-gated (no etcd in image)
+    """etcd-backed batched allocator (etcd_sequencer.go:34-135): a CAS
+    loop reserves [start, start+step) under a well-known key; only every
+    `step` allocations touch etcd."""
+
+    KEY = "/seaweedfs_tpu/max_file_id"
+
+    def __init__(self, endpoints: str, step: int = 100):
+        try:
+            import etcd3  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "etcd sequencer needs the etcd3 client installed") from e
+        import etcd3
+        host, _, port = endpoints.split(",")[0].partition(":")
+        self._client = etcd3.client(host=host, port=int(port or 2379))
+        self.step = step
+        self._lock = threading.Lock()
+        self._counter = 0
+        self._ceiling = 0
+
+    def _reserve_locked(self, need: int) -> None:
+        """CAS-extend the etcd checkpoint until it covers `need` ids."""
+        tx = self._client.transactions
+        while self._ceiling < need:
+            raw, _ = self._client.get(self.KEY)
+            cur = int(raw or 0)
+            new = max(cur, need, self._counter) + self.step
+            if raw is None:
+                # create-if-absent: version==0 compare makes two fresh
+                # masters race safely (one wins, the other retries)
+                ok, _ = self._client.transaction(
+                    compare=[tx.version(self.KEY) == 0],
+                    success=[tx.put(self.KEY, str(new))],
+                    failure=[])
+            else:
+                ok, _ = self._client.transaction(
+                    compare=[tx.value(self.KEY) == raw],
+                    success=[tx.put(self.KEY, str(new))],
+                    failure=[])
+            if ok:
+                self._counter = max(self._counter, cur, 1)
+                self._ceiling = new
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            first = max(self._counter, 1)
+            # cover the WHOLE batch (count may exceed step: /dir/assign
+            # lets clients pick count)
+            self._reserve_locked(first + count)
+            self._counter = first + count
             return first
 
     def set_max(self, seen: int) -> None:

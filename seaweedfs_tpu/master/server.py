@@ -36,7 +36,8 @@ class MasterServer:
                  jwt_key: str = "",
                  peers: list[str] | None = None,
                  election_timeout: tuple[float, float] = (1.0, 2.0),
-                 election_pulse: float = 0.3):
+                 election_pulse: float = 0.3,
+                 sequencer: str = "memory"):
         self.ip = ip
         self.port = port
         self._peers = list(peers or [])
@@ -48,7 +49,16 @@ class MasterServer:
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.topo = Topology(pulse_seconds=pulse_seconds)
-        self.seq = MemorySequencer()
+        # -sequencer memory | file:<path> | etcd:<host:port>
+        # (master.toml [master.sequencer], scaffold.go:362-371)
+        if sequencer.startswith("file:"):
+            from .sequence import FileSequencer
+            self.seq = FileSequencer(sequencer[5:])
+        elif sequencer.startswith("etcd:"):
+            from .sequence import EtcdSequencer
+            self.seq = EtcdSequencer(sequencer[5:])
+        else:
+            self.seq = MemorySequencer()
         self.layouts: dict[LayoutKey, VolumeLayout] = {}
         self._watchers: list[asyncio.Queue] = []
         self._runner: web.AppRunner | None = None
@@ -75,6 +85,8 @@ class MasterServer:
         app.router.add_get("/vol/ec_lookup", self.h_ec_lookup)
         app.router.add_post("/raft/vote", self.h_raft_vote)
         app.router.add_post("/raft/heartbeat", self.h_raft_heartbeat)
+        app.router.add_get("/ui", self.h_ui)
+        app.router.add_get("/", self.h_ui)
         return app
 
     @property
@@ -411,6 +423,32 @@ class MasterServer:
                     await resp.read()
                 deleted.append(vid)
         return web.json_response({"deleted": sorted(set(deleted))})
+
+    async def h_ui(self, req: web.Request) -> web.Response:
+        """Live cluster status page (server/master_ui/templates.go)."""
+        from html import escape
+        rows = []
+        for node in self.topo.all_nodes():
+            # heartbeat-supplied strings are untrusted: escape everything
+            dc = escape(node.rack.data_center.id if node.rack else "")
+            rack = escape(node.rack.id if node.rack else "")
+            url = escape(node.url)
+            rows.append(
+                f"<tr><td>{dc}</td><td>{rack}</td>"
+                f"<td><a href='{escape(tls.url(node.url, '/ui'), quote=True)}'>"
+                f"{url}</a></td><td>{len(node.volumes)}</td>"
+                f"<td>{node.ec_shard_count()}</td>"
+                f"<td>{node.max_volume_count}</td></tr>")
+        html = f"""<!DOCTYPE html><html><head><title>seaweedfs_tpu master
+</title></head><body><h1>seaweedfs_tpu master {self.url}</h1>
+<p>leader: {self.leader_url or '(none)'} | term:
+{self.election.term if self.election else 0} | max volume id:
+{self.topo.max_volume_id} | sequencer at: {self.seq.peek()}</p>
+<h2>Topology</h2>
+<table border=1 cellpadding=4><tr><th>DC</th><th>Rack</th><th>Node</th>
+<th>Volumes</th><th>EC shards</th><th>Max</th></tr>{''.join(rows)}</table>
+</body></html>"""
+        return web.Response(text=html, content_type="text/html")
 
     # ---- watch stream (KeepConnected pubsub, master_grpc_server.go:181) ----
 

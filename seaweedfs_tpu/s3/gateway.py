@@ -89,8 +89,7 @@ class S3Gateway:
             self._gc_task = asyncio.create_task(self._chunk_gc_loop())
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.ip, self.port,
-                            ssl_context=tls.server_ctx())
+        site = web.TCPSite(self._runner, self.ip, self.port)
         await site.start()
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]

@@ -91,8 +91,7 @@ class FilerServer:
         self._pending: list[str] = []
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.ip, self.port,
-                            ssl_context=tls.server_ctx())
+        site = web.TCPSite(self._runner, self.ip, self.port)
         await site.start()
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]

@@ -87,6 +87,7 @@ class VolumeServer:
         app.router.add_post("/admin/tier/download", self.h_tier_download)
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", self.h_metrics)
+        app.router.add_get("/ui", self.h_ui)
         # public needle API — catch-all LAST
         app.router.add_route("GET", "/{fid:[^/]+}", self.h_get)
         app.router.add_route("HEAD", "/{fid:[^/]+}", self.h_get)
@@ -516,6 +517,32 @@ class VolumeServer:
             "ecVolumes": {vid: sorted(ev.shards)
                           for vid, ev in self.store.ec_volumes.items()},
         })
+
+    async def h_ui(self, req: web.Request) -> web.Response:
+        """Live volume status page (server/volume_server_ui/)."""
+        from html import escape
+        rows = []
+        for v in self.store.volumes.values():
+            m = self.store._volume_message(v)
+            # collection names come from user-controlled assign params:
+            # escape to keep the admin page XSS-free
+            rows.append(
+                f"<tr><td>{m.id}</td><td>{escape(m.collection) or '-'}</td>"
+                f"<td>{m.size}</td><td>{m.file_count}</td>"
+                f"<td>{m.delete_count}</td>"
+                f"<td>{'ro' if m.read_only else 'rw'}</td></tr>")
+        ec_rows = [f"<tr><td>{vid}</td><td>{sorted(ev.shards)}</td></tr>"
+                   for vid, ev in self.store.ec_volumes.items()]
+        html = f"""<!DOCTYPE html><html><head><title>seaweedfs_tpu volume
+</title></head><body><h1>seaweedfs_tpu volume server {escape(self.url)}</h1>
+<p>master: {escape(self.master_url)} | dc: {escape(self.data_center) or '-'}
+| rack: {escape(self.rack) or '-'}</p>
+<h2>Volumes</h2><table border=1 cellpadding=4><tr><th>Id</th>
+<th>Collection</th><th>Size</th><th>Files</th><th>Deleted</th><th>Mode</th>
+</tr>{''.join(rows)}</table>
+<h2>EC shards</h2><table border=1 cellpadding=4><tr><th>Volume</th>
+<th>Shards</th></tr>{''.join(ec_rows)}</table></body></html>"""
+        return web.Response(text=html, content_type="text/html")
 
     async def h_allocate(self, req: web.Request) -> web.Response:
         q = req.query

@@ -9,6 +9,7 @@ import json
 import shlex
 
 from . import ec_commands as ec
+from . import fs_commands as fs
 from . import volume_commands as vc
 from .env import CommandEnv
 
@@ -23,6 +24,16 @@ HELP = """commands:
   volume.tier.upload   -volumeId n [-backend s3.default] [-keepLocal]
   volume.tier.download -volumeId n
   volume.list
+  collection.list
+  collection.delete -collection c
+  fs.ls   -filer host:port [-path /dir] [-l]
+  fs.cat  -filer host:port -path /f
+  fs.du   -filer host:port [-path /dir]
+  fs.tree -filer host:port [-path /dir]
+  fs.mv   -filer host:port -from /a -to /b
+  fs.rm   -filer host:port -path /f [-recursive]
+  fs.meta.save -filer host:port [-path /] [-o meta.jsonl]
+  fs.meta.load -filer host:port [-i meta.jsonl]
 """
 
 
@@ -86,6 +97,42 @@ async def run_command(master_url: str, line: str) -> object:
             res = await vc.volume_tier_download(env, int(flags["volumeId"]))
         elif cmd == "volume.list":
             res = await env.list_nodes()
+        elif cmd == "collection.list":
+            res = await fs.collection_list(env)
+        elif cmd == "collection.delete":
+            res = await fs.collection_delete(env, flags["collection"])
+        elif cmd.startswith("fs."):
+            filer = flags.get("filer", "")
+            if not filer:
+                raise ValueError("fs.* commands need -filer host:port")
+            path = flags.get("path", "/")
+            if cmd == "fs.ls":
+                res = await fs.fs_ls(env, filer, path,
+                                     long_format=flags.get("l") == "true")
+            elif cmd == "fs.cat":
+                data = await fs.fs_cat(env, filer, path)
+                print(data.decode(errors="replace"))
+                return None
+            elif cmd == "fs.du":
+                res = await fs.fs_du(env, filer, path)
+            elif cmd == "fs.tree":
+                print(await fs.fs_tree(env, filer, path))
+                return None
+            elif cmd == "fs.mv":
+                res = await fs.fs_mv(env, filer, flags["from"],
+                                     flags["to"])
+            elif cmd == "fs.rm":
+                res = await fs.fs_rm(env, filer, path,
+                                     recursive=flags.get(
+                                         "recursive") == "true")
+            elif cmd == "fs.meta.save":
+                res = await fs.fs_meta_save(env, filer, path,
+                                            flags.get("o", "meta.jsonl"))
+            elif cmd == "fs.meta.load":
+                res = await fs.fs_meta_load(env, filer,
+                                            flags.get("i", "meta.jsonl"))
+            else:
+                raise ValueError(f"unknown command {cmd!r}; try 'help'")
         else:
             raise ValueError(f"unknown command {cmd!r}; try 'help'")
     print(json.dumps(res, indent=2, default=str))

@@ -1,0 +1,35 @@
+"""CPU/memory profiling behind flags on every long-running command.
+
+Reference: weed/util/pprof.go `SetupProfiling(cpuProfile, memProfile)`,
+wired at command/master.go:74-75, volume.go, mount_std.go:28. Python
+analog: cProfile stats dumped at exit for CPU, tracemalloc top-25 for
+memory.
+"""
+
+from __future__ import annotations
+
+import atexit
+
+
+def setup_profiling(cpu_profile: str = "", mem_profile: str = "") -> None:
+    if cpu_profile:
+        import cProfile
+        prof = cProfile.Profile()
+        prof.enable()
+
+        def _dump_cpu() -> None:
+            prof.disable()
+            prof.dump_stats(cpu_profile)
+
+        atexit.register(_dump_cpu)
+    if mem_profile:
+        import tracemalloc
+        tracemalloc.start(25)
+
+        def _dump_mem() -> None:
+            snap = tracemalloc.take_snapshot()
+            with open(mem_profile, "w") as f:
+                for stat in snap.statistics("lineno")[:100]:
+                    f.write(f"{stat}\n")
+
+        atexit.register(_dump_mem)
