@@ -134,10 +134,13 @@ class EtcdSequencer:  # pragma: no cover - driver-gated (no etcd in image)
 
     def next_file_id(self, count: int = 1) -> int:
         with self._lock:
+            # reserve FIRST: it may raise _counter to the etcd checkpoint
+            # (ids below it were issued by a previous life or a peer);
+            # computing `first` before would reissue them
+            self._reserve_locked(max(self._counter, 1) + count)
             first = max(self._counter, 1)
-            # cover the WHOLE batch (count may exceed step: /dir/assign
-            # lets clients pick count)
-            self._reserve_locked(first + count)
+            if first + count > self._ceiling:
+                self._reserve_locked(first + count)
             self._counter = first + count
             return first
 

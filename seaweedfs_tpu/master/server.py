@@ -433,10 +433,14 @@ class MasterServer:
             dc = escape(node.rack.data_center.id if node.rack else "")
             rack = escape(node.rack.id if node.rack else "")
             url = escape(node.url)
+            # under mesh mTLS a browser can't present the role client
+            # cert, so don't render a link it cannot follow
+            cell = (url if tls.enabled() else
+                    f"<a href='{escape(tls.url(node.url, '/ui'), quote=True)}'>"
+                    f"{url}</a>")
             rows.append(
                 f"<tr><td>{dc}</td><td>{rack}</td>"
-                f"<td><a href='{escape(tls.url(node.url, '/ui'), quote=True)}'>"
-                f"{url}</a></td><td>{len(node.volumes)}</td>"
+                f"<td>{cell}</td><td>{len(node.volumes)}</td>"
                 f"<td>{node.ec_shard_count()}</td>"
                 f"<td>{node.max_volume_count}</td></tr>")
         html = f"""<!DOCTYPE html><html><head><title>seaweedfs_tpu master

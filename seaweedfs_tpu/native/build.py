@@ -63,6 +63,22 @@ def load() -> ctypes.CDLL | None:
             # transforms run GIL-free and must never race a lazy init
             lib.swtpu_gf256_init.restype = None
             lib.swtpu_gf256_init()
+            u64p = ctypes.POINTER(ctypes.c_uint64)
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            lib.swtpu_nm_new.restype = ctypes.c_void_p
+            lib.swtpu_nm_free.argtypes = [ctypes.c_void_p]
+            lib.swtpu_nm_set.restype = ctypes.c_int
+            lib.swtpu_nm_set.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                         ctypes.c_uint32, ctypes.c_uint32,
+                                         u32p, u32p]
+            lib.swtpu_nm_get.restype = ctypes.c_int
+            lib.swtpu_nm_get.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                         u32p, u32p]
+            lib.swtpu_nm_len.restype = ctypes.c_uint64
+            lib.swtpu_nm_len.argtypes = [ctypes.c_void_p]
+            lib.swtpu_nm_scan.restype = ctypes.c_uint64
+            lib.swtpu_nm_scan.argtypes = [ctypes.c_void_p, u64p, u64p,
+                                          u32p, u32p, ctypes.c_uint64]
             _lib = lib
         except Exception:
             _failed = True

@@ -15,7 +15,10 @@ from .env import CommandEnv
 
 
 def _filer_url(filer: str, path: str) -> str:
-    return tls.url(filer, path if path.startswith("/") else "/" + path)
+    # the filer HTTP surface is deliberately plaintext even when the
+    # master/volume mesh runs mTLS (client-facing, like the reference)
+    return f"http://{filer}" + (path if path.startswith("/")
+                                else "/" + path)
 
 
 _PAGE = 1024
@@ -145,6 +148,7 @@ async def fs_meta_load(env: CommandEnv, filer: str, in_file: str) -> dict:
     restoring onto the same cluster restores files, onto a fresh cluster
     restores the namespace (command_fs_meta_load.go semantics)."""
     n = 0
+    failures: list[str] = []
     with open(in_file) as f:
         for line in f:
             if not line.strip():
@@ -154,7 +158,15 @@ async def fs_meta_load(env: CommandEnv, filer: str, in_file: str) -> dict:
                                      json=e) as resp:
                 if resp.status == 200:
                     n += 1
-    return {"loaded": n, "file": in_file}
+                else:
+                    # a partial restore must never look like success
+                    failures.append(
+                        f"{e.get('FullPath')}: http {resp.status} "
+                        f"{(await resp.text())[:120]}")
+    out = {"loaded": n, "failed": len(failures), "file": in_file}
+    if failures:
+        out["errors"] = failures[:10]
+    return out
 
 
 async def collection_list(env: CommandEnv) -> list[str]:

@@ -122,7 +122,10 @@ async def run_command(master_url: str, line: str) -> object:
                 res = await fs.fs_mv(env, filer, flags["from"],
                                      flags["to"])
             elif cmd == "fs.rm":
-                res = await fs.fs_rm(env, filer, path,
+                if "path" not in flags:
+                    # never let a forgotten -path default to deleting "/"
+                    raise ValueError("fs.rm requires an explicit -path")
+                res = await fs.fs_rm(env, filer, flags["path"],
                                      recursive=flags.get(
                                          "recursive") == "true")
             elif cmd == "fs.meta.save":

@@ -50,9 +50,13 @@ def walk_index_file(path: str,
 class MemoryNeedleMap:
     """In-memory map + .idx append log (needle_map_memory.go)."""
 
+    @staticmethod
+    def _new_map():
+        return {}
+
     def __init__(self, index_path: str | None = None):
         self.index_path = index_path
-        self._m: dict[int, NeedleValue] = {}
+        self._m = self._new_map()
         self._idx: io.BufferedWriter | None = None
         self.deleted_count = 0
         self.deleted_bytes = 0
@@ -147,6 +151,70 @@ class MemoryNeedleMap:
     @property
     def deleted_size(self) -> int:
         return self.deleted_bytes
+
+
+class _NativeMapAdapter:
+    """dict-shaped facade over native.needle_map.NativeMap, storing
+    NeedleValue payloads at 16 bytes/entry instead of ~200 for a dict.
+    Key 0 (reserved as the native empty marker) gets a sideband slot."""
+
+    def __init__(self):
+        from ..native.needle_map import NativeMap
+        self._nm = NativeMap()
+        self._zero: NeedleValue | None = None
+
+    def get(self, key: int) -> NeedleValue | None:
+        if key == 0:
+            return self._zero
+        r = self._nm.get(key)
+        if r is None:
+            return None
+        # offsets are stored /8 like the .idx format: a raw byte offset
+        # would wrap the native uint32 field past 4 GiB (volumes default
+        # to 30 GB)
+        return NeedleValue(key, r[0] * t.NEEDLE_PADDING_SIZE, r[1])
+
+    def __setitem__(self, key: int, val: "NeedleValue") -> None:
+        if key == 0:
+            self._zero = val
+            return
+        assert val.offset % t.NEEDLE_PADDING_SIZE == 0, val.offset
+        self._nm.set(key, val.offset // t.NEEDLE_PADDING_SIZE, val.size)
+
+    def __len__(self) -> int:
+        return len(self._nm) + (1 if self._zero is not None else 0)
+
+    def keys(self):
+        if self._zero is not None:
+            yield 0
+        for k, _, _ in self._nm.items():
+            yield k
+
+    def close(self) -> None:
+        self._nm.close()
+
+
+class CompactNeedleMap(MemoryNeedleMap):
+    """MemoryNeedleMap on the native compact store (needle_map.c) — the
+    CompactMap analog (compact_map.go:14-40; its perf test budgets 100M
+    entries/volume, far beyond what a Python dict can hold)."""
+
+    @staticmethod
+    def _new_map():
+        return _NativeMapAdapter()
+
+    def close(self) -> None:
+        super().close()
+        self._m.close()
+
+
+def best_needle_map(index_path: str | None = None) -> MemoryNeedleMap:
+    """CompactNeedleMap when the native library is built, else the dict
+    map (NeedleMapType selection, storage/needle_map.go:12-19)."""
+    from ..native import needle_map as native_nm
+    if native_nm.available():
+        return CompactNeedleMap(index_path)
+    return MemoryNeedleMap(index_path)
 
 
 class SortedFileNeedleMap:

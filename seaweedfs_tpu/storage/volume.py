@@ -15,7 +15,7 @@ from dataclasses import dataclass
 
 from . import types as t
 from .needle import Needle, NeedleError
-from .needle_map import MemoryNeedleMap
+from .needle_map import best_needle_map
 from .super_block import SuperBlock, ReplicaPlacement
 
 
@@ -76,7 +76,7 @@ class Volume:
                 self.super_block = SuperBlock.from_bytes(self._dat.read(8))
                 self.is_remote = True
                 self.read_only = True
-                self.nm = MemoryNeedleMap(base + ".idx")
+                self.nm = best_needle_map(base + ".idx")
                 last = self.nm.last_entry
                 if last is not None and last[1] > 0:
                     try:
@@ -116,7 +116,7 @@ class Volume:
                     os.posix_fallocate(self._dat.fileno(), 0, preallocate)
                 except OSError:
                     pass
-        self.nm = MemoryNeedleMap(base + ".idx")
+        self.nm = best_needle_map(base + ".idx")
         self._check_integrity()
 
     def reload(self) -> None:
@@ -126,7 +126,7 @@ class Volume:
         base = self.file_name()
         self._dat = open(base + ".dat", "r+b")
         self.super_block = SuperBlock.from_bytes(self._dat.read(8))
-        self.nm = MemoryNeedleMap(base + ".idx")
+        self.nm = best_needle_map(base + ".idx")
         from . import backend as _backend
         # a .vif means the volume is tiered (keep_local): stay sealed so
         # local writes can't diverge from the remote object
