@@ -114,6 +114,10 @@ def build_parser() -> argparse.ArgumentParser:
     s3p.add_argument("-port", type=int, default=8333)
     s3p.add_argument("-store", default="sqlite")
     s3p.add_argument("-dbPath", default="./s3filer.db")
+    s3p.add_argument("-accessKey", default="",
+                     help="require SigV4 auth with this access key "
+                          "(empty = anonymous)")
+    s3p.add_argument("-secretKey", default="")
 
     wd = sub.add_parser("webdav", help="start a WebDAV gateway")
     _add_common(wd)
@@ -399,8 +403,10 @@ async def _run_s3(args) -> None:
     from .filer.filer import Filer
     from .s3.gateway import S3Gateway
     kwargs = _store_kwargs(args.store, args.dbPath)
+    identities = ({args.accessKey: args.secretKey}
+                  if args.accessKey else None)
     s3 = S3Gateway(Filer(args.store, **kwargs), args.master,
-                   ip=args.ip, port=args.port)
+                   ip=args.ip, port=args.port, identities=identities)
     await s3.start()
     print(f"s3 gateway listening on {s3.url}")
     await asyncio.Event().wait()

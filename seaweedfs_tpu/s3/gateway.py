@@ -23,6 +23,8 @@ import aiohttp
 from aiohttp import web
 
 from ..filer.entry import Attr, Entry, new_directory_entry
+from .auth import (AuthError, AwsChunkedDecoder, SigV4Verifier,
+                   is_aws_chunked)
 from ..filer.filechunks import FileChunk, etag as chunks_etag, view_from_chunks
 from ..filer.stream import stream_chunk_views
 from ..filer.filer import Filer, FilerError
@@ -56,22 +58,47 @@ def _ts(t: float) -> str:
 class S3Gateway:
     def __init__(self, filer: Filer, master_url: str,
                  ip: str = "127.0.0.1", port: int = 8333,
-                 chunk_size: int = 8 * 1024 * 1024):
+                 chunk_size: int = 8 * 1024 * 1024,
+                 identities: dict[str, str] | None = None):
         self.filer = filer
         self.master_url = master_url
         self.ip = ip
         self.port = port
         self.chunk_size = chunk_size
+        # {access_key: secret_key}; empty == anonymous mode
+        # (s3api_auth.go authTypeAnonymous when no identities configured)
+        self.identities = dict(identities or {})
+        self._verifier = SigV4Verifier(self.identities)
         self.client: WeedClient | None = None
         self._runner: web.AppRunner | None = None
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=5 * 1024 * 1024 * 1024)
+        app = web.Application(client_max_size=5 * 1024 * 1024 * 1024,
+                              middlewares=[self._auth_middleware])
         app.router.add_route("GET", "/", self.h_list_buckets)
         app.router.add_route("*", "/{bucket}", self.h_bucket)
         app.router.add_route("*", "/{bucket}/{key:.+}", self.h_object)
         return app
+
+    @web.middleware
+    async def _auth_middleware(self, req: web.Request, handler):
+        if self.identities:
+            try:
+                req["s3auth"] = self._verifier.verify(
+                    req.method, req.path,
+                    dict(req.query), req.headers, None)
+            except AuthError as e:
+                status = (403 if e.code in ("AccessDenied",
+                                            "SignatureDoesNotMatch",
+                                            "InvalidAccessKeyId")
+                          else 400)
+                return _err(e.code, str(e), status)
+        try:
+            return await handler(req)
+        except AuthError as e:
+            # mid-stream chunk-signature failures surface here
+            return _err(e.code, str(e), 403)
 
     @property
     def url(self) -> str:
@@ -302,8 +329,16 @@ class S3Gateway:
         if self.filer.find_entry(f"{BUCKETS_DIR}/{bucket}") is None:
             return _err("NoSuchBucket", bucket, 404)
         mime = req.headers.get("Content-Type", "")
-        chunks, md5 = await self._store_stream(
-            req.content, collection=bucket, mime=mime)
+        chunks, md5, sha_hex = await self._store_stream(
+            self._body_reader(req), collection=bucket, mime=mime)
+        ctx = req.get("s3auth")
+        if ctx is not None and len(ctx.content_sha256) == 64:
+            # the client signed a concrete payload hash: enforce it, or a
+            # replayed signature could smuggle a different body
+            if ctx.content_sha256 != sha_hex:
+                self.filer.delete_chunks([c.file_id for c in chunks])
+                return _err("XAmzContentSHA256Mismatch",
+                            "payload does not match signed hash", 400)
         now = time.time()
         entry = Entry(path, Attr(mtime=now, crtime=now, mime=mime,
                                  collection=bucket), chunks)
@@ -315,11 +350,20 @@ class S3Gateway:
         return web.Response(status=200,
                             headers={"ETag": f'"{md5.hexdigest()}"'})
 
+    def _body_reader(self, req: web.Request):
+        """Raw body stream, stripping aws-chunked signature framing when
+        the SDK streams with STREAMING-AWS4-HMAC-SHA256-PAYLOAD; chunk
+        signatures are verified when the request was authenticated."""
+        if is_aws_chunked(req.headers):
+            return AwsChunkedDecoder(req.content, req.get("s3auth"))
+        return req.content
+
     async def _store_stream(self, reader, collection: str,
                             mime: str = "") -> tuple[list[FileChunk], object]:
         chunks: list[FileChunk] = []
         offset = 0
         md5 = hashlib.md5()
+        sha256 = hashlib.sha256()
         while True:
             data = bytearray()
             while len(data) < self.chunk_size:
@@ -330,6 +374,7 @@ class S3Gateway:
             if not data:
                 break
             md5.update(data)
+            sha256.update(data)
             a = await self.client.assign(collection=collection)
             up = await self.client.upload(a["fid"], a["url"], bytes(data),
                                           mime=mime,
@@ -339,7 +384,7 @@ class S3Gateway:
             offset += len(data)
             if len(data) < self.chunk_size:
                 break
-        return chunks, md5
+        return chunks, md5, sha256.hexdigest()
 
     async def _copy_object(self, src: str, dst_path: str) -> web.Response:
         src = urllib.parse.unquote(src).lstrip("/")
@@ -436,8 +481,8 @@ class S3Gateway:
 
         if req.method == "PUT" and "partNumber" in q:
             part = int(q["partNumber"])
-            chunks, md5 = await self._store_stream(req.content,
-                                                   collection=bucket)
+            chunks, md5, _ = await self._store_stream(
+                self._body_reader(req), collection=bucket)
             now = time.time()
             self.filer.create_entry(Entry(
                 f"{updir}/{part:04d}.part", Attr(mtime=now, crtime=now),
