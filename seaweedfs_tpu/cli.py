@@ -63,6 +63,12 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
     v.add_argument("-pulseSeconds", type=float, default=5.0)
+    v.add_argument("-compactionMBps", type=int, default=0,
+                   help="vacuum copy rate limit, MB/s (0 = unthrottled)")
+    v.add_argument("-index", default="auto",
+                   choices=["auto", "memory", "compact", "disk"],
+                   help="needle map kind (reference -index=memory|leveldb;"
+                        " disk = sqlite-backed, near-zero RAM)")
     v.add_argument("-jwtKey", default="")
     v.add_argument("-tierS3Endpoint", default="",
                    help="S3-compatible endpoint for volume.tier.upload "
@@ -183,6 +189,16 @@ def build_parser() -> argparse.ArgumentParser:
     ex.add_argument("-dir", default=".")
     ex.add_argument("-volumeId", type=int, required=True)
     ex.add_argument("-collection", default="")
+    ex.add_argument("-o", dest="output", default="",
+                    help="write file contents to this .tar instead of "
+                         "printing the JSON listing")
+    ex.add_argument("-fileNameFormat", default="{name}",
+                    help="tar member naming: {name} {id} {mime}")
+    ex.add_argument("-newer", default="",
+                    help="only needles modified after this "
+                         "YYYY-MM-DDThh:mm:ss")
+    ex.add_argument("-pattern", default="",
+                    help="only file names matching this glob")
 
     co = sub.add_parser("compact", help="offline-compact one volume")
     co.add_argument("-dir", default=".")
@@ -242,7 +258,10 @@ async def _run_volume(args) -> None:
         load_backends({"s3": {"default": {
             "endpoint": args.tierS3Endpoint,
             "bucket": args.tierS3Bucket}}})
-    store = Store(dirs, max_volume_counts=maxes)
+    store = Store(dirs, max_volume_counts=maxes,
+                  compaction_bytes_per_second=args.compactionMBps
+                  * 1024 * 1024,
+                  index_type=args.index)
     vs = VolumeServer(store, args.master, ip=args.ip, port=args.port,
                       data_center=args.dataCenter, rack=args.rack,
                       pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey)
@@ -676,18 +695,70 @@ def _run_fix(args) -> None:
 
 
 def _run_export(args) -> None:
+    """List needles as JSON lines, or -o out.tar to dump contents
+    (reference: weed export w/ -o tar, -fileNameFormat, -newer,
+    command/export.go)."""
+    import fnmatch
+    import io
+    import tarfile
+
     from .storage.volume import Volume
     v = Volume(args.dir, args.collection, args.volumeId,
                create_if_missing=False)
+    newer_ts = 0.0
+    if args.newer:
+        newer_ts = time.mktime(
+            time.strptime(args.newer, "%Y-%m-%dT%H:%M:%S"))
+    tar = tarfile.open(args.output, "w") if args.output else None
+    exported = 0
+
+    def want(n) -> bool:
+        name = n.name.decode(errors="replace")
+        if args.pattern and not fnmatch.fnmatch(name, args.pattern):
+            return False
+        if newer_ts and getattr(n, "last_modified", 0) < newer_ts:
+            return False
+        return True
+
+    from .storage import types as _t
+
+    def _is_live(n, offset) -> bool:
+        # a scanned record is live only if the needle map still points at
+        # THIS offset (overwritten/deleted data must not be resurrected)
+        nv = v.nm.get(n.id)
+        return (nv is not None and nv.offset == offset
+                and nv.size != _t.TOMBSTONE_FILE_SIZE)
 
     def visit(n, offset):
+        nonlocal exported
         kind = "tombstone" if n.size == 0 and not n.data else "needle"
-        print(json.dumps({
-            "key": n.id, "cookie": n.cookie, "size": n.size,
-            "offset": offset, "name": n.name.decode(errors="replace"),
-            "mime": n.mime.decode(errors="replace"), "type": kind}))
+        if tar is None:
+            # listing mode keeps every historical record (incl.
+            # tombstones) — it is the audit view of the raw .dat
+            if kind == "needle" and not want(n):
+                return
+            print(json.dumps({
+                "key": n.id, "cookie": n.cookie, "size": n.size,
+                "offset": offset, "name": n.name.decode(errors="replace"),
+                "mime": n.mime.decode(errors="replace"), "type": kind,
+                "live": kind == "needle" and _is_live(n, offset)}))
+            return
+        if kind == "tombstone" or not want(n) or not _is_live(n, offset):
+            return
+        exported += 1
+        member = args.fileNameFormat.format(
+            name=n.name.decode(errors="replace") or f"{n.id:x}",
+            id=f"{n.id:x}", mime=n.mime.decode(errors="replace"))
+        info = tarfile.TarInfo(member)
+        info.size = len(n.data)
+        info.mtime = int(getattr(n, "last_modified", 0) or 0)
+        tar.addfile(info, io.BytesIO(bytes(n.data)))
+
     v.scan(visit)
     v.close()
+    if tar is not None:
+        tar.close()
+        print(f"exported {exported} files to {args.output}")
 
 
 def _run_compact(args) -> None:

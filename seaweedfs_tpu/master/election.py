@@ -190,6 +190,10 @@ class Election:
             await self._broadcast_pulse()
         else:
             self._step_down()
+            # reset the election timer: retrying immediately would keep
+            # split candidates colliding in lockstep (the randomized
+            # timeout only de-syncs them if both wait a fresh one)
+            self.last_pulse = time.monotonic()
 
     async def _broadcast_pulse(self) -> int:
         """One leader pulse round. Returns the ack count (incl. self) and

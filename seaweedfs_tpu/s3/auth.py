@@ -50,10 +50,13 @@ def _canonical_query(query: "dict[str, str] | list[tuple[str, str]]",
 def _canonical_request(method: str, path: str, cq: str,
                        signed_headers: list[str],
                        headers, payload_hash: str) -> str:
+    """`path` must be the RAW (still percent-encoded) request path: S3
+    SigV4 signs it verbatim, and requoting a decoded path corrupts keys
+    whose encoding is not a decode-requote fixed point (a%2Fb)."""
     canon_headers = "".join(
         f"{h}:{' '.join(headers.get(h, '').split())}\n"
         for h in signed_headers)
-    return "\n".join([method, urllib.parse.quote(path, safe="/-_.~"), cq,
+    return "\n".join([method, path, cq,
                       canon_headers, ";".join(signed_headers),
                       payload_hash])
 
@@ -109,15 +112,21 @@ class SigV4Verifier:
 
     def verify(self, method: str, path: str, query, headers,
                payload_hash: str | None) -> "AuthContext":
-        """Returns the authenticated AuthContext. Raises AuthError."""
-        kind = self.auth_type(headers, query)
+        """Returns the authenticated AuthContext. Raises AuthError.
+
+        `query` may be a dict or a list of (key, value) pairs — pass the
+        pair list when duplicate query keys are possible (dict would
+        collapse them and break the canonical query string)."""
+        qd = query if isinstance(query, dict) else dict(query)
+        kind = self.auth_type(headers, qd)
         if kind == "anonymous":
             raise AuthError("AccessDenied", "anonymous access denied")
         if kind == "unsupported":
             raise AuthError("AccessDenied",
                             "unsupported authorization scheme")
         if kind == "presigned":
-            return self._verify_presigned(method, path, query, headers)
+            return self._verify_presigned(method, path, query, qd,
+                                          headers)
         return self._verify_header(method, path, query, headers,
                                    payload_hash)
 
@@ -173,8 +182,9 @@ class SigV4Verifier:
                             "request signature mismatch")
         return AuthContext(access_key, key, scope, amz_date, want, payload)
 
-    def _verify_presigned(self, method, path, query, headers) -> str:
-        cred = query.get("X-Amz-Credential", "")
+    def _verify_presigned(self, method, path, query, qd,
+                          headers) -> "AuthContext":
+        cred = qd.get("X-Amz-Credential", "")
         try:
             access_key, date, region, service, _ = \
                 urllib.parse.unquote(cred).split("/", 4)
@@ -182,18 +192,18 @@ class SigV4Verifier:
             raise AuthError("AuthorizationQueryParametersError",
                             f"bad X-Amz-Credential {cred!r}") from None
         secret = self._secret_for(access_key)
-        amz_date = query.get("X-Amz-Date", "")
+        amz_date = qd.get("X-Amz-Date", "")
         # expiry check
         try:
             t0 = datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
                 tzinfo=timezone.utc)
-            expires = int(query.get("X-Amz-Expires", "0"))
+            expires = int(qd.get("X-Amz-Expires", "0"))
         except ValueError:
             raise AuthError("AuthorizationQueryParametersError",
                             "bad X-Amz-Date/X-Amz-Expires") from None
         if datetime.now(timezone.utc) > t0 + timedelta(seconds=expires):
             raise AuthError("AccessDenied", "request has expired")
-        signed = query.get("X-Amz-SignedHeaders", "host").split(";")
+        signed = qd.get("X-Amz-SignedHeaders", "host").split(";")
         scope = f"{date}/{region}/{service}/aws4_request"
         canonical = _canonical_request(
             method, path, _canonical_query(query, drop_signature=True),
@@ -201,7 +211,7 @@ class SigV4Verifier:
         sts = _string_to_sign(amz_date, scope, canonical)
         key = signing_key(secret, date, region, service)
         want = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
-        if not hmac.compare_digest(want, query.get("X-Amz-Signature", "")):
+        if not hmac.compare_digest(want, qd.get("X-Amz-Signature", "")):
             raise AuthError("SignatureDoesNotMatch",
                             "presigned signature mismatch")
         return AuthContext(access_key, key, scope, amz_date, want, UNSIGNED)
@@ -211,33 +221,33 @@ def _lower_headers(headers) -> dict:
     return {k.lower(): v for k, v in headers.items()}
 
 
-def decode_aws_chunked(body: bytes) -> bytes:
-    """Decode STREAMING-AWS4-HMAC-SHA256-PAYLOAD framing:
-    <hex-size>;chunk-signature=<sig>\r\n<data>\r\n ... 0;...\r\n\r\n
-    (chunked_reader_v4.go). Signatures are framing-validated here; the
-    whole-object integrity is covered by the needle CRC downstream."""
-    out = bytearray()
-    i = 0
-    n = len(body)
-    while i < n:
-        j = body.find(b"\r\n", i)
-        if j < 0:
-            raise AuthError("IncompleteBody", "bad chunk header")
-        header = body[i:j].decode("ascii", "replace")
-        size_hex = header.split(";", 1)[0]
-        try:
-            size = int(size_hex, 16)
-        except ValueError:
-            raise AuthError("IncompleteBody",
-                            f"bad chunk size {size_hex!r}") from None
-        i = j + 2
-        if size == 0:
-            break
-        if i + size > n:
-            raise AuthError("IncompleteBody", "truncated chunk")
-        out += body[i:i + size]
-        i += size + 2  # trailing \r\n
-    return bytes(out)
+def decode_aws_chunked(body: bytes,
+                       ctx: "AuthContext | None" = None) -> bytes:
+    """Whole-buffer convenience wrapper over AwsChunkedDecoder (single
+    framing implementation; same validation rules)."""
+    import asyncio
+    import io
+
+    class _Reader:
+        def __init__(self, data: bytes):
+            self._f = io.BytesIO(data)
+
+        async def readline(self) -> bytes:
+            return self._f.readline()
+
+        async def read(self, n: int) -> bytes:
+            return self._f.read(n)
+
+        async def readexactly(self, n: int) -> bytes:
+            data = self._f.read(n)
+            if len(data) != n:
+                raise asyncio.IncompleteReadError(data, n)
+            return data
+
+    async def run() -> bytes:
+        return await AwsChunkedDecoder(_Reader(body), ctx).read()
+
+    return asyncio.run(run())
 
 
 class AwsChunkedDecoder:
@@ -263,8 +273,11 @@ class AwsChunkedDecoder:
         while line in (b"\r\n", b"\n"):
             line = await self.raw.readline()
         if not line:
-            self.done = True
-            return
+            # EOF before the terminal 0-size chunk: the stream's sealing
+            # signature was never presented — a truncated body must not
+            # be stored as a complete object
+            raise AuthError("IncompleteBody",
+                            "stream ended before the final chunk")
         header = line.strip().decode("ascii", "replace")
         size_hex, _, rest = header.partition(";")
         try:

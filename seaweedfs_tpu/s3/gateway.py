@@ -55,6 +55,12 @@ def _ts(t: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(t))
 
 
+def _auth_status(e: AuthError) -> int:
+    return (403 if e.code in ("AccessDenied", "SignatureDoesNotMatch",
+                              "InvalidAccessKeyId")
+            else 400)
+
+
 class S3Gateway:
     def __init__(self, filer: Filer, master_url: str,
                  ip: str = "127.0.0.1", port: int = 8333,
@@ -85,20 +91,19 @@ class S3Gateway:
     async def _auth_middleware(self, req: web.Request, handler):
         if self.identities:
             try:
+                # raw_path: SigV4 signs the encoded form verbatim, and a
+                # decode-requote round trip corrupts keys like a%2Fb;
+                # items list: dict() would collapse duplicate query keys
                 req["s3auth"] = self._verifier.verify(
-                    req.method, req.path,
-                    dict(req.query), req.headers, None)
+                    req.method, req.rel_url.raw_path,
+                    list(req.query.items()), req.headers, None)
             except AuthError as e:
-                status = (403 if e.code in ("AccessDenied",
-                                            "SignatureDoesNotMatch",
-                                            "InvalidAccessKeyId")
-                          else 400)
-                return _err(e.code, str(e), status)
+                return _err(e.code, str(e), _auth_status(e))
         try:
             return await handler(req)
         except AuthError as e:
-            # mid-stream chunk-signature failures surface here
-            return _err(e.code, str(e), 403)
+            # mid-stream chunk-signature / truncation failures
+            return _err(e.code, str(e), _auth_status(e))
 
     @property
     def url(self) -> str:
@@ -331,14 +336,8 @@ class S3Gateway:
         mime = req.headers.get("Content-Type", "")
         chunks, md5, sha_hex = await self._store_stream(
             self._body_reader(req), collection=bucket, mime=mime)
-        ctx = req.get("s3auth")
-        if ctx is not None and len(ctx.content_sha256) == 64:
-            # the client signed a concrete payload hash: enforce it, or a
-            # replayed signature could smuggle a different body
-            if ctx.content_sha256 != sha_hex:
-                self.filer.delete_chunks([c.file_id for c in chunks])
-                return _err("XAmzContentSHA256Mismatch",
-                            "payload does not match signed hash", 400)
+        if (bad := self._payload_hash_mismatch(req, chunks, sha_hex)):
+            return bad
         now = time.time()
         entry = Entry(path, Attr(mtime=now, crtime=now, mime=mime,
                                  collection=bucket), chunks)
@@ -349,6 +348,19 @@ class S3Gateway:
             return _err("InternalError", str(e), 500)
         return web.Response(status=200,
                             headers={"ETag": f'"{md5.hexdigest()}"'})
+
+    def _payload_hash_mismatch(self, req: web.Request, chunks,
+                               sha_hex: str) -> web.Response | None:
+        """When the client signed a concrete payload hash, enforce it —
+        otherwise a replayed signature could smuggle a different body.
+        Cleans up the uploaded chunks on mismatch."""
+        ctx = req.get("s3auth")
+        if ctx is not None and len(ctx.content_sha256) == 64 \
+                and ctx.content_sha256 != sha_hex:
+            self.filer.delete_chunks([c.file_id for c in chunks])
+            return _err("XAmzContentSHA256Mismatch",
+                        "payload does not match signed hash", 400)
+        return None
 
     def _body_reader(self, req: web.Request):
         """Raw body stream, stripping aws-chunked signature framing when
@@ -364,13 +376,30 @@ class S3Gateway:
         offset = 0
         md5 = hashlib.md5()
         sha256 = hashlib.sha256()
+        try:
+            await self._store_stream_inner(reader, collection, mime,
+                                           chunks, md5, sha256)
+        except Exception:
+            # mid-stream failure (bad chunk signature, truncated body,
+            # volume error): the already-uploaded chunks must not leak
+            self.filer.delete_chunks([c.file_id for c in chunks])
+            raise
+        return chunks, md5, sha256.hexdigest()
+
+    async def _store_stream_inner(self, reader, collection, mime,
+                                  chunks, md5, sha256) -> None:
+        offset = 0
         while True:
-            data = bytearray()
-            while len(data) < self.chunk_size:
-                part = await reader.read(self.chunk_size - len(data))
-                if not part:
-                    break
-                data.extend(part)
+            try:
+                data = bytearray()
+                while len(data) < self.chunk_size:
+                    part = await reader.read(self.chunk_size - len(data))
+                    if not part:
+                        break
+                    data.extend(part)
+            except asyncio.IncompleteReadError:
+                raise AuthError("IncompleteBody",
+                                "request body ended mid-chunk") from None
             if not data:
                 break
             md5.update(data)
@@ -384,7 +413,6 @@ class S3Gateway:
             offset += len(data)
             if len(data) < self.chunk_size:
                 break
-        return chunks, md5, sha256.hexdigest()
 
     async def _copy_object(self, src: str, dst_path: str) -> web.Response:
         src = urllib.parse.unquote(src).lstrip("/")
@@ -481,8 +509,10 @@ class S3Gateway:
 
         if req.method == "PUT" and "partNumber" in q:
             part = int(q["partNumber"])
-            chunks, md5, _ = await self._store_stream(
+            chunks, md5, sha_hex = await self._store_stream(
                 self._body_reader(req), collection=bucket)
+            if (bad := self._payload_hash_mismatch(req, chunks, sha_hex)):
+                return bad
             now = time.time()
             self.filer.create_entry(Entry(
                 f"{updir}/{part:04d}.part", Attr(mtime=now, crtime=now),

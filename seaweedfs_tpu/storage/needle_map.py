@@ -208,9 +208,104 @@ class CompactNeedleMap(MemoryNeedleMap):
         self._m.close()
 
 
-def best_needle_map(index_path: str | None = None) -> MemoryNeedleMap:
-    """CompactNeedleMap when the native library is built, else the dict
-    map (NeedleMapType selection, storage/needle_map.go:12-19)."""
+class _SqliteMapAdapter:
+    """dict-shaped facade over a sqlite table, same contract as
+    _NativeMapAdapter — lets DiskNeedleMap inherit every line of the
+    counter/tombstone bookkeeping instead of forking it."""
+
+    def __init__(self, path: str):
+        import sqlite3
+        import threading
+        self.path = path
+        # served from event-loop AND executor threads: one shared
+        # connection guarded by a lock (sqlite objects are
+        # thread-affine by default)
+        self._lock = threading.Lock()
+        # autocommit + WAL: each put is durable without explicit commits
+        self._db = sqlite3.connect(path, isolation_level=None,
+                                   check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute("CREATE TABLE IF NOT EXISTS needles("
+                         "key INTEGER PRIMARY KEY, offset INTEGER, "
+                         "size INTEGER)")
+        # the .idx replay repopulates from scratch on every open (the
+        # reference replays only the stale tail; full replay is simpler
+        # and the .idx stays the source of truth)
+        self._db.execute("DELETE FROM needles")
+
+    def get(self, key: int) -> NeedleValue | None:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT offset, size FROM needles WHERE key=?",
+                (key,)).fetchone()
+        return NeedleValue(key, row[0], row[1]) if row else None
+
+    def __setitem__(self, key: int, val: "NeedleValue") -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO needles VALUES (?,?,?)",
+                (key, val.offset, val.size))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._db.execute(
+                "SELECT COUNT(*) FROM needles").fetchone()[0]
+
+    def keys(self):
+        with self._lock:
+            ks = [k for (k,) in self._db.execute(
+                "SELECT key FROM needles ORDER BY key")]
+        yield from ks
+
+    def close(self) -> None:
+        with self._lock:
+            if self._db is not None:
+                self._db.close()
+                self._db = None
+
+    def destroy_files(self) -> None:
+        for suffix in ("", "-wal", "-shm"):
+            if os.path.exists(self.path + suffix):
+                os.remove(self.path + suffix)
+
+
+class DiskNeedleMap(MemoryNeedleMap):
+    """Disk-backed needle map for memory-constrained servers — the role
+    of LevelDbNeedleMap (needle_map_leveldb.go: key index on disk, only
+    counters in RAM), on sqlite instead of leveldb (no cgo-free leveldb
+    in this image)."""
+
+    def _new_map(self):
+        import uuid
+        path = ((self.index_path + ".sdb") if self.index_path
+                else os.path.join("/tmp", f"swtpu-nm-{uuid.uuid4()}.sdb"))
+        return _SqliteMapAdapter(path)
+
+    def close(self) -> None:
+        super().close()
+        self._m.close()
+
+    def destroy(self) -> None:
+        super().destroy()
+        self._m.destroy_files()
+
+
+def best_needle_map(index_path: str | None = None,
+                    kind: str = "auto") -> MemoryNeedleMap:
+    """NeedleMapType selection (storage/needle_map.go:12-19, the
+    -index=memory|leveldb flag):
+    auto    — native CompactNeedleMap when built, else dict map
+    memory  — dict map
+    compact — native map (raises if the toolchain is unavailable)
+    disk    — sqlite-backed DiskNeedleMap (LevelDbNeedleMap analog,
+              near-zero RAM per entry)"""
+    if kind == "memory":
+        return MemoryNeedleMap(index_path)
+    if kind == "disk":
+        return DiskNeedleMap(index_path)
+    if kind == "compact":
+        return CompactNeedleMap(index_path)
     from ..native import needle_map as native_nm
     if native_nm.available():
         return CompactNeedleMap(index_path)

@@ -31,8 +31,15 @@ class Store:
                  port: int = 0, public_url: str = "",
                  max_volume_counts: list[int] | None = None,
                  ec_large_block: int = LARGE_BLOCK_SIZE,
-                 ec_small_block: int = SMALL_BLOCK_SIZE):
+                 ec_small_block: int = SMALL_BLOCK_SIZE,
+                 compaction_bytes_per_second: int = 0,
+                 index_type: str = "auto"):
+        # needle map kind for every owned volume (-index flag analog)
+        self.index_type = index_type
         self.dirs = dirs
+        # vacuum copy rate limit applied to every owned volume
+        # (compactionBytePerSecond flag)
+        self.compaction_bytes_per_second = compaction_bytes_per_second
         self.ip = ip
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
@@ -41,6 +48,7 @@ class Store:
         self.ec_small_block = ec_small_block
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, EcVolume] = {}
+        # note: _own() applies per-store volume policy on every Volume
         self._lock = threading.RLock()
         # deltas queued for the next heartbeat
         self.new_volumes: list[pb.VolumeInformationMessage] = []
@@ -63,8 +71,9 @@ class Store:
             vid = int(m.group("vid"))
             col = m.group("col") or ""
             try:
-                self.volumes[vid] = Volume(d, col, vid,
-                                           create_if_missing=False)
+                self.volumes[vid] = self._own(Volume(
+                    d, col, vid, create_if_missing=False,
+                    needle_map_kind=self.index_type))
             except VolumeError:
                 continue
         for path in glob.glob(os.path.join(d, "*.vif")):
@@ -77,8 +86,9 @@ class Store:
                 continue
             col = m.group("col") or ""
             try:
-                self.volumes[vid] = Volume(d, col, vid,
-                                           create_if_missing=False)
+                self.volumes[vid] = self._own(Volume(
+                    d, col, vid, create_if_missing=False,
+                    needle_map_kind=self.index_type))
             except Exception:
                 # backend unreachable or not configured yet: skip
                 continue
@@ -112,15 +122,21 @@ class Store:
 
     # ---- volume lifecycle ----
 
+    def _own(self, v: Volume) -> Volume:
+        v.compaction_bytes_per_second = self.compaction_bytes_per_second
+        return v
+
     def add_volume(self, vid: int, collection: str = "",
                    replication: str = "", ttl: str = "",
                    preallocate: int = 0) -> Volume:
         with self._lock:
             if vid in self.volumes:
                 raise VolumeError(f"volume {vid} already exists")
-            v = Volume(self.dirs[vid % len(self.dirs)], collection, vid,
-                       replica_placement=ReplicaPlacement.parse(replication),
-                       ttl=t.TTL.parse(ttl), preallocate=preallocate)
+            v = self._own(Volume(
+                self.dirs[vid % len(self.dirs)], collection, vid,
+                replica_placement=ReplicaPlacement.parse(replication),
+                ttl=t.TTL.parse(ttl), preallocate=preallocate,
+                needle_map_kind=self.index_type))
             self.volumes[vid] = v
             self.new_volumes.append(self._volume_message(v))
             return v
@@ -147,7 +163,9 @@ class Store:
                 base = os.path.join(
                     d, f"{collection}_{vid}" if collection else str(vid))
                 if os.path.exists(base + ".dat"):
-                    v = Volume(d, collection, vid, create_if_missing=False)
+                    v = self._own(Volume(d, collection, vid,
+                                         create_if_missing=False,
+                                         needle_map_kind=self.index_type))
                     self.volumes[vid] = v
                     self.new_volumes.append(self._volume_message(v))
                     return

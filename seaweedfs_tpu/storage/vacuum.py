@@ -44,6 +44,7 @@ def compact(v: Volume) -> None:
                     ttl=v.super_block.ttl,
                     compaction_revision=v.super_block.compaction_revision + 1)
     # separate read-only fd: never share seek state with live writers
+    throttle = _Throttler(v.compaction_bytes_per_second)
     with open(base + ".dat", "rb") as src, \
             open(base + ".cpd", "wb") as dst, \
             open(base + ".cpx", "wb") as idx:
@@ -63,6 +64,28 @@ def compact(v: Volume) -> None:
             idx.write(_IDX_ENTRY.pack(
                 key, new_offset // t.NEEDLE_PADDING_SIZE, nv.size))
             new_offset += blob_len
+            throttle.maybe_sleep(blob_len)
+
+
+class _Throttler:
+    """Compaction rate limiter (util/throttler.go): sleep whenever the
+    copied-bytes budget for the elapsed wall time is exceeded, so vacuum
+    doesn't starve live reads on the same spindle. 0 = unthrottled."""
+
+    def __init__(self, bytes_per_second: int):
+        self.bps = bytes_per_second
+        self.start = time.monotonic()
+        self.copied = 0
+
+    def maybe_sleep(self, n: int) -> None:
+        if self.bps <= 0:
+            return
+        self.copied += n
+        # sleep the FULL deficit: capping per-call would let large
+        # needles outrun the budget (the deficit is never drained)
+        ahead = self.copied / self.bps - (time.monotonic() - self.start)
+        if ahead > 0:
+            time.sleep(ahead)
 
 
 def commit_compact(v: Volume) -> None:

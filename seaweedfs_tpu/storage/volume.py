@@ -47,13 +47,18 @@ class Volume:
                  replica_placement: ReplicaPlacement | None = None,
                  ttl: t.TTL | None = None,
                  preallocate: int = 0,
-                 create_if_missing: bool = True):
+                 create_if_missing: bool = True,
+                 needle_map_kind: str = "auto"):
+        self.needle_map_kind = needle_map_kind
         self.dir = dirname
         self.collection = collection
         self.vid = vid
         self.read_only = False
         self.last_append_at_ns = 0
         self.last_modified_ts = 0
+        # vacuum copy rate limit, bytes/s; 0 = unthrottled
+        # (compactionBytePerSecond flag + util/throttler.go)
+        self.compaction_bytes_per_second = 0
         self._lock = threading.RLock()
 
         base = self.file_name()
@@ -76,7 +81,7 @@ class Volume:
                 self.super_block = SuperBlock.from_bytes(self._dat.read(8))
                 self.is_remote = True
                 self.read_only = True
-                self.nm = best_needle_map(base + ".idx")
+                self.nm = best_needle_map(base + ".idx", self.needle_map_kind)
                 last = self.nm.last_entry
                 if last is not None and last[1] > 0:
                     try:
@@ -116,7 +121,7 @@ class Volume:
                     os.posix_fallocate(self._dat.fileno(), 0, preallocate)
                 except OSError:
                     pass
-        self.nm = best_needle_map(base + ".idx")
+        self.nm = best_needle_map(base + ".idx", self.needle_map_kind)
         self._check_integrity()
 
     def reload(self) -> None:
@@ -126,7 +131,7 @@ class Volume:
         base = self.file_name()
         self._dat = open(base + ".dat", "r+b")
         self.super_block = SuperBlock.from_bytes(self._dat.read(8))
-        self.nm = best_needle_map(base + ".idx")
+        self.nm = best_needle_map(base + ".idx", self.needle_map_kind)
         from . import backend as _backend
         # a .vif means the volume is tiered (keep_local): stay sealed so
         # local writes can't diverge from the remote object

@@ -32,10 +32,12 @@ async def _make_cluster(n: int = 3) -> list[MasterServer]:
     urls = [f"127.0.0.1:{p}" for p in ports]
     masters = []
     for p in ports:
+        # generous margins: under full-suite load the event loop can stall
+        # past a tight lease window and flake the test with leader churn
         m = MasterServer(port=p, pulse_seconds=0.1,
                          peers=urls,
-                         election_timeout=(0.15, 0.35),
-                         election_pulse=0.05)
+                         election_timeout=(0.4, 0.8),
+                         election_pulse=0.1)
         await m.start()
         masters.append(m)
     return masters

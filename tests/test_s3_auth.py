@@ -33,7 +33,7 @@ def _sign_headers(method: str, host: str, path: str,
         f"{urllib.parse.quote(v, safe='-_.~')}"
         for k, v in sorted((query or {}).items()))
     canon = "\n".join([
-        method, urllib.parse.quote(path, safe="/-_.~"), cq,
+        method, path, cq,  # path = raw wire form, signed verbatim
         "".join(f"{h}:{headers[h]}\n" for h in signed),
         ";".join(signed), payload_hash])
     scope = f"{date}/{REGION}/s3/aws4_request"
