@@ -48,13 +48,22 @@ def _transform_buffers(encoder, coeff: np.ndarray,
     """Apply a GF coefficient matrix to equal-length host byte buffers."""
     from .encoder_jax import JaxEncoder
     if isinstance(encoder, JaxEncoder):
+        import os
+
         import jax
         from ..ops.gf256_pallas import (bytes_to_words, gf256_words_transform,
                                         words_to_bytes)
         n = len(buffers[0])
         words = [jax.device_put(bytes_to_words(b)) for b in buffers]
-        consts = gf.bitplane_constants(coeff)
-        outs = gf256_words_transform(consts, words)
+        if os.environ.get("SWTPU_EC_METHOD") == "mxu":
+            # MXU GF(2) bit-matrix formulation (ops/gf256_mxu.py); the
+            # default VPU Pallas kernel wins at small sizes, the MXU at
+            # large streams — bench.py races both
+            from ..ops.gf256_mxu import mxu_words_transform
+            outs = mxu_words_transform(np.asarray(coeff, np.uint8), words)
+        else:
+            consts = gf.bitplane_constants(coeff)
+            outs = gf256_words_transform(consts, words)
         return [words_to_bytes(np.asarray(o), n).copy() for o in outs]
     # CPU path: native AVX2 kernel when built, numpy table lookup otherwise
     from .encoder_cpu import CpuEncoder
