@@ -1,28 +1,144 @@
 """Benchmark: RS(10,4) erasure-coding throughput on the attached TPU chip.
 
-Prints ONE JSON line:
+ALWAYS prints exactly ONE JSON line on stdout, no matter what fails:
   {"metric": "...", "value": N, "unit": "GB/s", "vs_baseline": N, ...}
 
 value      = sustained encode+rebuild data throughput per chip (GB/s of
              data-shard bytes processed; min of encode and worst-case
-             4-missing rebuild, the BASELINE.json north-star metric).
+             4-missing rebuild — the BASELINE.json north-star metric).
 vs_baseline= ratio vs the host CPU encoder measured in the same run (the
-             stand-in for the reference's AVX2 reedsolomon path on this
-             machine).
+             stand-in for the reference's AVX2 reedsolomon path,
+             /root/reference/go.mod:41 klauspost/reedsolomon).
+
+Robustness design (the round-1 bench died in backend init and produced no
+number at all):
+  * All TPU work runs in a KILLABLE CHILD PROCESS ("python bench.py
+    --child") with a wall-clock budget; backend init that hangs (the axon
+    tunnel can wedge for minutes) is killed, retried once, then abandoned.
+  * The child VERIFIES each kernel path on-device against the CPU oracle
+    before timing it — a fast-but-wrong path is never reported.
+  * The child measures incrementally (small shapes first) and streams each
+    cumulative result as a JSON line; the parent keeps the last complete
+    one, so even a mid-measurement kill yields a real number.
+  * The parent embeds an "error" field and falls back to the CPU number if
+    the TPU path dies entirely.
+Progress is logged to stderr so a hang is diagnosable.
+
+Env knobs:
+  SWTPU_BENCH_BUDGET_S   total wall-clock budget (default 420)
+  SWTPU_BENCH_INIT_S     backend-init timeout per attempt (default 180)
+  SWTPU_BENCH_BYTES      max bytes per shard in the largest stage
+  JAX_PLATFORMS=cpu      force the CPU interpret path (CI)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
+import threading
 import time
 
 import numpy as np
 
+_T0 = time.perf_counter()
+
+
+def _log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T0:6.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# CPU baseline (parent process; no jax import needed)
+# ---------------------------------------------------------------------------
+
+
+def bench_cpu(n_bytes_per_shard: int = 4 << 20) -> tuple[float, str]:
+    """Host baseline: the best available CPU encoder — the native AVX2
+    kernel (native/gf256.c, analog of the reference's reedsolomon assembly
+    path) when built, else the numpy table-lookup fallback."""
+    from seaweedfs_tpu.ec import gf
+    from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
+
+    enc = CpuEncoder()
+    kind = "native-avx2" if enc.use_native else "numpy"
+    rng = np.random.default_rng(7)
+    data = [rng.integers(0, 256, n_bytes_per_shard).astype(np.uint8)
+            for _ in range(gf.DATA_SHARDS)]
+    enc.encode(list(data))  # warm tables
+    t0 = time.perf_counter()
+    iters = 3
+    for _ in range(iters):
+        enc.encode(list(data))
+    dt = (time.perf_counter() - t0) / iters
+    return gf.DATA_SHARDS * n_bytes_per_shard / dt / 1e9, kind
+
+
+# ---------------------------------------------------------------------------
+# Child: all device work. Streams cumulative JSON results line-by-line.
+# ---------------------------------------------------------------------------
+
+
+def _emit(result: dict) -> None:
+    print(json.dumps(result), flush=True)
+
+
+def _verify_paths_on_device(n_small: int = 256 << 10) -> dict:
+    """Encode+rebuild a small slab on device with each kernel path and
+    compare byte-for-byte against the CPU oracle (the ec_test.go dual-read
+    discipline applied to the kernel itself). Returns {path: True|err}."""
+    import jax
+
+    from seaweedfs_tpu.ec import gf
+    from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
+    from seaweedfs_tpu.ops import gf256_mxu as gm
+    from seaweedfs_tpu.ops import gf256_pallas as gp
+
+    rng = np.random.default_rng(3)
+    data = [rng.integers(0, 256, n_small).astype(np.uint8)
+            for _ in range(gf.DATA_SHARDS)]
+    oracle = CpuEncoder(use_native=False)
+    reb_coeff = gf.shard_rows([0, 1, 2, 3], list(range(4, 14)))
+    full = oracle.encode(list(data))
+    want_parity = full[gf.DATA_SHARDS:]
+    want_reb = oracle._apply_numpy(reb_coeff, full[4:14])
+
+    # IMPORTANT: verify with the DEFAULT block_bm so the exact pallas_call
+    # instantiation (BlockSpec/grid) that gets timed is the one checked;
+    # n_small spans >1 grid block to cover the pipelined multi-block path
+    words = [jax.device_put(gp.bytes_to_words(d)) for d in data]
+    wn = words[0].shape[0] * 512
+    reb_in = [jax.device_put(gp.bytes_to_words(full[i]))
+              for i in range(4, 14)]
+    enc_coeff = gf.parity_matrix()
+    paths = {
+        "vpu": lambda c, ws: gp.gf256_words_transform(
+            gf.bitplane_constants(c), ws),
+        "mxu": gm.mxu_words_transform,
+    }
+    status: dict = {}
+    for name, fn in paths.items():
+        try:
+            got_p = [gp.words_to_bytes(np.asarray(o), n_small)
+                     for o in fn(enc_coeff, words)]
+            got_r = [gp.words_to_bytes(np.asarray(o), n_small)
+                     for o in fn(reb_coeff, reb_in)]
+            ok = (all(np.array_equal(g, w)
+                      for g, w in zip(got_p, want_parity))
+                  and all(np.array_equal(g, w)
+                          for g, w in zip(got_r, want_reb)))
+            status[name] = True if ok else "MISMATCH vs CPU oracle"
+        except Exception as e:  # noqa: BLE001 — one path must not kill both
+            status[name] = f"{type(e).__name__}: {e}"[:200]
+        _log(f"oracle check {name} ({wn}B/shard): {status[name]}")
+    return status
+
 
 def _roundtrip_latency() -> float:
-    """Per-dispatch round-trip cost (the axon tunnel adds ~70ms; real
-    local PJRT would be sub-ms). Measured so it can be amortised out."""
+    """Per-dispatch round-trip cost (the axon tunnel adds ~70ms; local
+    PJRT would be sub-ms). Measured so it can be amortised out."""
     import jax
     import jax.numpy as jnp
 
@@ -30,7 +146,7 @@ def _roundtrip_latency() -> float:
     tiny = jax.jit(lambda x: jnp.sum(x))
     float(tiny(z))
     t0 = time.perf_counter()
-    iters = 10
+    iters = 5
     for _ in range(iters):
         float(tiny(z))
     return (time.perf_counter() - t0) / iters
@@ -56,7 +172,7 @@ def _chained_gbs(transform, consts, words, n: int, chain_len: int,
         return sum(jnp.sum(x, dtype=jnp.uint32) for x in ws[:rows])
 
     float(chain(*words))  # compile
-    iters = 3
+    iters = 2
     t0 = time.perf_counter()
     for _ in range(iters):
         float(chain(*words))
@@ -65,114 +181,242 @@ def _chained_gbs(transform, consts, words, n: int, chain_len: int,
     return k * n / per_step / 1e9
 
 
-def bench_tpu(n_bytes_per_shard: int = 64 << 20, chain_len: int = 16) -> dict:
+def child_main() -> None:
+    deadline = _T0 + float(os.environ.get("SWTPU_BENCH_CHILD_S", "300"))
+
+    def left() -> float:
+        return deadline - time.perf_counter()
+
     import jax
     import jax.numpy as jnp
 
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # the axon sitecustomize force-registers the TPU tunnel regardless
+        # of JAX_PLATFORMS; jax.config wins at backend-init time
+        jax.config.update("jax_platforms", "cpu")
+    _log("initialising jax backend ...")
+    backend = jax.default_backend()
+    _log(f"backend up: {backend} devices={jax.devices()}")
+    _emit({"stage": "init", "backend": backend})
+
     from seaweedfs_tpu.ec import gf
-
-    n = n_bytes_per_shard
-    k = gf.DATA_SHARDS
-    # generate the stripes ON DEVICE: a device_put of 640MB through the
-    # axon tunnel takes minutes, while PRNG keys are a few bytes
-    make = jax.jit(
-        lambda key: jax.random.bits(key, (n // 512, 128), jnp.uint32))
-    keys = jax.random.split(jax.random.PRNGKey(0), k)
-    words = [make(keys[i]) for i in range(k)]
-    jax.block_until_ready(words)
-    rtt = _roundtrip_latency()
-
-    from seaweedfs_tpu.ops import gf256_pallas as gp
     from seaweedfs_tpu.ops import gf256_mxu as gm
+    from seaweedfs_tpu.ops import gf256_pallas as gp
+
+    status = _verify_paths_on_device()
+    _emit({"stage": "oracle", "paths_verified": status})
+    good = [p for p, st in status.items() if st is True]
+    if not good:
+        _emit({"stage": "done", "error": f"no kernel path passed the "
+               f"on-device oracle check: {status}"})
+        return
+
+    rtt = _roundtrip_latency()
+    _log(f"dispatch rtt {rtt * 1e3:.1f} ms")
 
     enc_coeff = gf.parity_matrix()
     # worst-case rebuild: all 4 lost are data shards, rebuilt from
     # shards 4..13 (6 data + 4 parity)
     reb_coeff = gf.shard_rows([0, 1, 2, 3], list(range(4, 14)))
-
-    # race the two TPU formulations (VPU bitplane kernel vs MXU GF(2)
-    # bit-matrix matmul) and take the best per operation
     paths = {
         "vpu": lambda c, ws: gp.gf256_words_transform(
             gf.bitplane_constants(c), ws),
         "mxu": gm.mxu_words_transform,
     }
-    detail = {}
-    for name, fn in paths.items():
+
+    max_bytes = int(os.environ.get(
+        "SWTPU_BENCH_BYTES", str((64 << 20) if backend == "tpu"
+                                 else (1 << 20))))
+    stages = [(s, c) for s, c in [
+        (1 << 20, 1), (4 << 20, 2), (16 << 20, 4), (64 << 20, 8),
+        (256 << 20, 8)] if s <= max_bytes]
+    if not stages:  # tiny SWTPU_BENCH_BYTES: still measure one stage
+        stages = [(max(128 << 10, (max_bytes // (128 << 10)) * (128 << 10)),
+                   1)]
+    detail: dict = {"dispatch_rtt_ms": round(rtt * 1e3, 1)}
+
+    k = gf.DATA_SHARDS
+    for n, chain_len in stages:
+        if left() < 30:
+            _log(f"budget exhausted before stage n={n >> 20}MB — stopping")
+            break
+        # generate stripes ON DEVICE: device_put of NxGB through the axon
+        # tunnel takes minutes, PRNG keys are a few bytes
+        make = jax.jit(
+            lambda key: jax.random.bits(key, (n // 512, 128), jnp.uint32))
+        keys = jax.random.split(jax.random.PRNGKey(0), k)
+        words = [make(keys[i]) for i in range(k)]
+        jax.block_until_ready(words)
+        for name in good:
+            for op, coeff in (("encode", enc_coeff), ("rebuild4", reb_coeff)):
+                if left() < 15:
+                    break
+                try:
+                    gbs = _chained_gbs(paths[name], coeff, words, n,
+                                       chain_len, rtt)
+                except Exception as e:  # noqa: BLE001
+                    detail[f"{op}_{name}_error"] = str(e)[:200]
+                    _log(f"{op}/{name} n={n >> 20}MB FAILED: {e}")
+                    continue
+                key = f"{op}_{name}"
+                detail[key] = max(detail.get(key, 0.0), round(gbs, 2))
+                _log(f"{op}/{name} n={n >> 20}MB chain={chain_len}: "
+                     f"{gbs:.2f} GB/s")
+        enc = max((v for d, v in detail.items()
+                   if d.startswith("encode_") and isinstance(v, float)),
+                  default=0.0)
+        reb = max((v for d, v in detail.items()
+                   if d.startswith("rebuild4_") and isinstance(v, float)),
+                  default=0.0)
+        stage_res = {"stage": f"measure_{n >> 20}MB", "backend": backend,
+                     "encode_GBps": enc, "rebuild4_GBps": reb,
+                     "paths": detail}
+        if enc > 0 and reb > 0:  # "value" only once BOTH ops are measured
+            stage_res["value"] = min(enc, reb)
+        _emit(stage_res)
+    _emit({"stage": "done", "backend": backend})
+
+
+# ---------------------------------------------------------------------------
+# Parent: spawn/kill child, merge its stream, ALWAYS print the final line.
+# ---------------------------------------------------------------------------
+
+
+def _run_child(budget_s: float, init_s: float) -> tuple[dict, str | None]:
+    """Run the child under a wall-clock budget. Returns (merged result,
+    error string or None). Kills the child if it produces nothing within
+    init_s (wedged backend init) or overruns budget_s."""
+    merged: dict = {}
+    err: str | None = None
+    env = dict(os.environ, SWTPU_BENCH_CHILD_S=str(max(budget_s - 5, 30)))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        stdout=subprocess.PIPE, stderr=sys.stderr, text=True, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+    lines: list[str] = []
+    lock = threading.Lock()
+
+    def reader() -> None:
+        for line in proc.stdout:  # type: ignore[union-attr]
+            with lock:
+                lines.append(line)
+
+    th = threading.Thread(target=reader, daemon=True)
+    th.start()
+    start = time.perf_counter()
+    saw_output = False
+    while True:
+        alive = proc.poll() is None
+        with lock:
+            pending, lines = lines, []
+        for line in pending:
+            saw_output = True
+            try:
+                merged.update(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+        waited = time.perf_counter() - start
+        if not alive:
+            if proc.returncode != 0 and "value" not in merged:
+                err = f"child exited rc={proc.returncode}"
+            break
+        if not saw_output and waited > init_s:
+            err = f"backend init produced nothing in {init_s:.0f}s — killed"
+            proc.kill()
+            break
+        if waited > budget_s:
+            err = (None if "value" in merged
+                   else f"budget {budget_s:.0f}s exceeded — killed")
+            _log(f"child overran budget {budget_s:.0f}s; killing "
+                 f"(have partial result: {'value' in merged})")
+            proc.kill()
+            break
+        time.sleep(0.5)
+    proc.wait(timeout=10)
+    # final drain: lines still in the pipe / reader thread when the loop
+    # broke (fast child, or kill paths) would otherwise be lost
+    th.join(timeout=5)
+    with lock:
+        pending, lines = lines, []
+    for line in pending:
         try:
-            detail[f"encode_{name}"] = _chained_gbs(
-                fn, enc_coeff, words, n, chain_len, rtt)
-            detail[f"rebuild4_{name}"] = _chained_gbs(
-                fn, reb_coeff, words, n, chain_len, rtt)
-        except Exception as e:  # one path failing must not kill the bench
-            detail[f"{name}_error"] = str(e)[:200]
-    gbs_enc = max((v for d, v in detail.items()
-                   if d.startswith("encode_")), default=0.0)
-    gbs_reb = max((v for d, v in detail.items()
-                   if d.startswith("rebuild4_")), default=0.0)
-
-    return {"encode_gbs": gbs_enc, "rebuild4_gbs": gbs_reb,
-            "dispatch_rtt_ms": rtt * 1e3, "paths": detail,
-            "value": min(gbs_enc, gbs_reb)}
-
-
-def bench_cpu(n_bytes_per_shard: int = 4 << 20) -> tuple[float, str]:
-    """Host-baseline: the best available CPU encoder — the native AVX2
-    kernel (native/gf256.c, the analog of the reference's reedsolomon
-    assembly path) when built, else the numpy table-lookup fallback."""
-    from seaweedfs_tpu.ec import gf
-    from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
-
-    enc = CpuEncoder()
-    kind = "native-avx2" if enc.use_native else "numpy"
-    rng = np.random.default_rng(7)
-    data = [rng.integers(0, 256, n_bytes_per_shard).astype(np.uint8)
-            for _ in range(gf.DATA_SHARDS)]
-    enc.encode(list(data))  # warm tables
-    t0 = time.perf_counter()
-    iters = 3
-    for _ in range(iters):
-        enc.encode(list(data))
-    dt = (time.perf_counter() - t0) / iters
-    return gf.DATA_SHARDS * n_bytes_per_shard / dt / 1e9, kind
+            merged.update(json.loads(line))
+        except json.JSONDecodeError:
+            pass
+    if err and err.startswith("child exited") and "value" in merged:
+        err = None
+    return merged, err
 
 
 def main() -> None:
-    import jax
+    budget = float(os.environ.get("SWTPU_BENCH_BUDGET_S", "420"))
+    init_s = float(os.environ.get("SWTPU_BENCH_INIT_S", "180"))
+    result = {
+        "metric": "rs_10_4_encode_rebuild_GBps_per_chip",
+        "value": 0.0,
+        "unit": "GB/s",
+        "vs_baseline": 0.0,
+        "backend": "none",
+    }
+    try:
+        cpu_gbs, cpu_kind = bench_cpu()
+        result["cpu_baseline_GBps"] = round(cpu_gbs, 3)
+        result["cpu_baseline_kind"] = cpu_kind
+        _log(f"cpu baseline: {cpu_gbs:.3f} GB/s ({cpu_kind})")
+    except Exception as e:  # noqa: BLE001
+        cpu_gbs = 0.0
+        result["cpu_error"] = f"{type(e).__name__}: {e}"[:300]
+        _log(f"cpu baseline FAILED: {e}")
 
-    # the axon sitecustomize force-registers the TPU tunnel regardless of
-    # JAX_PLATFORMS in the environment; honor an explicit cpu request via
-    # jax.config, which wins because it is read at backend-init time
-    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    backend = jax.default_backend()
-    cpu_gbs, cpu_kind = bench_cpu()
-    n_env = os.environ.get("SWTPU_BENCH_BYTES")
-    if backend == "tpu":
-        tpu = bench_tpu(int(n_env) if n_env else 64 << 20)
-    else:  # no chip attached: measure the interpret path on tiny shapes
-        tpu = bench_tpu(int(n_env) if n_env else 256 << 10, chain_len=1)
-    value = tpu["value"]
+    merged: dict = {}
+    err: str | None = None
+    try:
+        remaining = budget - (time.perf_counter() - _T0)
+        merged, err = _run_child(remaining, min(init_s, remaining))
+        if err and "value" not in merged:
+            remaining = budget - (time.perf_counter() - _T0)
+            if remaining > 90:
+                _log(f"retrying child once ({err}); {remaining:.0f}s left")
+                merged, err2 = _run_child(remaining,
+                                          min(init_s, remaining - 30))
+                err = err2 or err
+    except Exception as e:  # noqa: BLE001 — the JSON line must still print
+        err = f"{type(e).__name__}: {e}"[:300]
+
+    if "value" in merged and merged.get("backend") != "none":
+        result["backend"] = merged.get("backend", "unknown")
+        result["value"] = round(float(merged["value"]), 2)
+        for key in ("encode_GBps", "rebuild4_GBps", "paths",
+                    "paths_verified"):
+            if key in merged:
+                result[key] = merged[key]
+        if cpu_gbs > 0:
+            result["vs_baseline"] = round(result["value"] / cpu_gbs, 2)
+    else:
+        # TPU path produced nothing usable: report the CPU number so the
+        # bench still yields a real measurement, flagged with the error
+        result["backend"] = "cpu-fallback"
+        result["value"] = round(cpu_gbs, 2)
+        result["vs_baseline"] = 1.0 if cpu_gbs > 0 else 0.0
+        if "paths_verified" in merged:
+            result["paths_verified"] = merged["paths_verified"]
+    if err:
+        result["error"] = err
+    if merged.get("error"):
+        result["error"] = (result.get("error", "") + "; " +
+                           merged["error"]).strip("; ")
+
     try:
         from seaweedfs_tpu.stats import metrics
         if metrics.HAVE_PROMETHEUS:
-            metrics.EC_THROUGHPUT.set(value)
-    except ImportError:
+            metrics.EC_THROUGHPUT.set(result["value"])
+    except Exception:  # noqa: BLE001
         pass
-    print(json.dumps({
-        "metric": "rs_10_4_encode_rebuild_GBps_per_chip",
-        "value": round(value, 2),
-        "unit": "GB/s",
-        "vs_baseline": round(value / cpu_gbs, 2),
-        "encode_GBps": round(tpu["encode_gbs"], 2),
-        "rebuild4_GBps": round(tpu["rebuild4_gbs"], 2),
-        "paths": {d: (round(v, 2) if isinstance(v, float) else v)
-                  for d, v in tpu.get("paths", {}).items()},
-        "cpu_baseline_GBps": round(cpu_gbs, 3),
-        "cpu_baseline_kind": cpu_kind,
-        "backend": backend,
-    }))
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv[1:]:
+        child_main()
+    else:
+        main()
