@@ -54,6 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-sequencer", default="memory",
                    help="file-id allocator: memory | file:<path> | "
                         "etcd:<host:port>")
+    m.add_argument("-mdir", default="",
+                   help="master metadata dir (persists election "
+                        "term/vote across restarts)")
 
     v = sub.add_parser("volume", help="start a volume server")
     _add_common(v)
@@ -237,7 +240,7 @@ async def _run_master(args) -> None:
                      pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
                      peers=[p.strip() for p in args.peers.split(",")
                             if p.strip()],
-                     sequencer=args.sequencer)
+                     sequencer=args.sequencer, meta_dir=args.mdir)
     await m.start()
     if args.metricsGateway:
         from .stats.metrics import push_loop
@@ -674,7 +677,7 @@ async def _run_backup(args) -> None:
 def _run_fix(args) -> None:
     """Rebuild .idx by scanning .dat (command/fix.go)."""
     from .storage import types as t
-    from .storage.needle_map import _ENTRY
+    from .storage.needle_map import pack_entry
     from .storage.volume import Volume
     v = Volume(args.dir, args.collection, args.volumeId,
                create_if_missing=False)
@@ -689,7 +692,7 @@ def _run_fix(args) -> None:
     idx_path = v.file_name() + ".idx"
     with open(idx_path, "wb") as f:
         for key, (off, size) in entries.items():
-            f.write(_ENTRY.pack(key, off // 8, size))
+            f.write(pack_entry(key, off, size))
     print(f"rebuilt {idx_path} with {len(entries)} entries")
     v.close()
 
@@ -826,6 +829,13 @@ def _discover_security_toml() -> None:
 
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
+    # SWTPU_OFFSET_BYTES=5: the reference's 5BytesOffset build tag as a
+    # runtime switch (8TB volumes; offset_5bytes.go:14-16). Process-wide,
+    # set before any volume or index file is opened.
+    env_off = os.environ.get("SWTPU_OFFSET_BYTES")
+    if env_off:
+        from .storage import types as _types
+        _types.set_offset_size(int(env_off))
     if hasattr(args, "verbosity"):
         from .util import glog
         glog.init(verbosity=args.verbosity,

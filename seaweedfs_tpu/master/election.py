@@ -16,6 +16,8 @@ from __future__ import annotations
 from ..security import tls
 
 import asyncio
+import json
+import os
 import random
 import time
 
@@ -38,7 +40,8 @@ class Election:
 
     def __init__(self, me: str, peers: list[str],
                  election_timeout: tuple[float, float] = (1.0, 2.0),
-                 pulse: float = 0.3):
+                 pulse: float = 0.3,
+                 state_path: str | None = None):
         self.me = self._norm(me)
         # peers excludes self (normalized, so localhost == 127.0.0.1);
         # empty peers == single-master mode
@@ -49,6 +52,21 @@ class Election:
         self.pulse = pulse
         self.term = 0
         self.voted_for: str | None = None
+        # durable (term, votedFor), written BEFORE any vote takes effect:
+        # without it a restarted master forgets it voted and can grant a
+        # second vote in the same term — a split-brain window the
+        # reference's raft layer persists away (raft_server.go:60-76)
+        self.state_path = state_path
+        if state_path and os.path.exists(state_path):
+            try:
+                with open(state_path) as f:
+                    st = json.load(f)
+                self.term = int(st.get("term", 0))
+                self.voted_for = st.get("voted_for") or None
+            except (OSError, ValueError) as e:
+                raise SystemExit(
+                    f"election state {state_path} unreadable/corrupt: {e};"
+                    f" repair or remove it explicitly") from e
         self.role = self.LEADER if self.single else self.FOLLOWER
         self.leader: str | None = self.me if self.single else None
         self.last_pulse = time.monotonic()
@@ -63,6 +81,18 @@ class Election:
     @property
     def is_leader(self) -> bool:
         return self.role == self.LEADER
+
+    def _persist(self) -> None:
+        """Atomically checkpoint (term, votedFor). Must complete before
+        the vote/term change is acted on (raft durability rule)."""
+        if not self.state_path:
+            return
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.state_path)
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -96,7 +126,8 @@ class Election:
             # entry that is really our address: only the local self-vote
             # in _campaign counts
             return {"term": self.term, "granted": False}
-        if term > self.term:
+        bumped = term > self.term
+        if bumped:
             self.term = term
             self.voted_for = None
             self._step_down()
@@ -109,6 +140,8 @@ class Election:
         if granted:
             self.voted_for = candidate
             self.last_pulse = time.monotonic()
+        if granted or bumped:
+            self._persist()  # durable before the reply leaves this node
         return {"term": self.term, "granted": granted}
 
     def on_leader_pulse(self, term: int, leader: str,
@@ -118,7 +151,8 @@ class Election:
         if term >= self.term:
             if term > self.term:
                 self.voted_for = None
-            self.term = term
+                self.term = term
+                self._persist()
             self.leader = leader
             if leader != self.me:
                 self._step_down()
@@ -159,6 +193,7 @@ class Election:
         self.term += 1
         term = self.term
         self.voted_for = self.me
+        self._persist()  # self-vote must be durable before soliciting
         self.leader = None
         votes = 1  # self-vote
 
@@ -175,6 +210,7 @@ class Election:
             if body.get("term", 0) > self.term:
                 self.term = body["term"]
                 self.voted_for = None
+                self._persist()
                 self._step_down()
             return bool(body.get("granted"))
 
@@ -211,6 +247,7 @@ class Election:
             if reply.get("term", 0) > self.term:
                 self.term = reply["term"]
                 self.voted_for = None
+                self._persist()
                 self._step_down()
                 return False
             return bool(reply.get("ok"))

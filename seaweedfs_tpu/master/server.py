@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
 
 from aiohttp import web
@@ -23,6 +24,7 @@ from ..topology.layout import (LayoutKey, PlacementError, VolumeLayout,
                                find_empty_slots)
 from ..topology.tree import DataNode, Topology
 from ..security import tls
+from ..util import glog
 from .election import Election
 from .sequence import MemorySequencer
 
@@ -37,12 +39,17 @@ class MasterServer:
                  peers: list[str] | None = None,
                  election_timeout: tuple[float, float] = (1.0, 2.0),
                  election_pulse: float = 0.3,
-                 sequencer: str = "memory"):
+                 sequencer: str = "memory",
+                 meta_dir: str = ""):
         self.ip = ip
         self.port = port
         self._peers = list(peers or [])
         self._election_timeout = election_timeout
         self._election_pulse = election_pulse
+        # -mdir: raft-state directory (reference -mdir, raft_server.go:60)
+        self.meta_dir = meta_dir
+        if meta_dir:
+            os.makedirs(meta_dir, exist_ok=True)
         self.election: Election | None = None
         self.jwt_key = jwt_key
         self.volume_size_limit = volume_size_limit_mb * 1024 * 1024
@@ -58,6 +65,16 @@ class MasterServer:
             from .sequence import EtcdSequencer
             self.seq = EtcdSequencer(sequencer[5:])
         else:
+            if self._peers:
+                # after leader failover a fresh MemorySequencer only
+                # catches up via heartbeat set_max (one pulse behind), so
+                # ids issued by the old leader in the last interval would
+                # be re-issued and overwrite needles — multi-master needs
+                # a durable/shared sequencer (file:/etcd:)
+                glog.warning(
+                    "multi-master (-peers) with the in-memory sequencer "
+                    "can re-issue file ids across failover; use "
+                    "-sequencer file:<path> or etcd:<endpoints>")
             self.seq = MemorySequencer()
         self.layouts: dict[LayoutKey, VolumeLayout] = {}
         self._watchers: list[asyncio.Queue] = []
@@ -106,7 +123,9 @@ class MasterServer:
         self.election = Election(
             self.url, self._peers,
             election_timeout=self._election_timeout,
-            pulse=self._election_pulse)
+            pulse=self._election_pulse,
+            state_path=(os.path.join(self.meta_dir, "raft_state.json")
+                        if self.meta_dir else None))
         self.election.get_max_volume_id = lambda: self.topo.max_volume_id
         self.election.adopt_max_volume_id = self._adopt_max_volume_id
         await self.election.start()

@@ -17,6 +17,10 @@ ALGORITHM = "AWS4-HMAC-SHA256"
 UNSIGNED = "UNSIGNED-PAYLOAD"
 STREAMING = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
 
+# Largest accepted aws-chunked chunk: SDKs send 8KB-1MB chunks; 16MB
+# bounds the per-chunk buffering a client-declared size can force.
+MAX_CHUNK_SIZE = 16 * 1024 * 1024
+
 
 class AuthError(Exception):
     def __init__(self, code: str, message: str):
@@ -201,7 +205,18 @@ class SigV4Verifier:
         except ValueError:
             raise AuthError("AuthorizationQueryParametersError",
                             "bad X-Amz-Date/X-Amz-Expires") from None
-        if datetime.now(timezone.utc) > t0 + timedelta(seconds=expires):
+        # AWS bounds presigned lifetime to 7 days; without this a
+        # credential holder could mint effectively non-expiring URLs
+        if not 1 <= expires <= 604800:
+            raise AuthError("AuthorizationQueryParametersError",
+                            "X-Amz-Expires must be in 1..604800")
+        now = datetime.now(timezone.utc)
+        # a far-future X-Amz-Date would extend the lifetime past the
+        # X-Amz-Expires cap; apply the header-auth 15-minute skew window
+        if (t0 - now).total_seconds() > 900:
+            raise AuthError("RequestTimeTooSkewed",
+                            "X-Amz-Date too far in the future")
+        if now > t0 + timedelta(seconds=expires):
             raise AuthError("AccessDenied", "request has expired")
         signed = qd.get("X-Amz-SignedHeaders", "host").split(";")
         scope = f"{date}/{region}/{service}/aws4_request"
@@ -218,6 +233,19 @@ class SigV4Verifier:
 
 
 def _lower_headers(headers) -> dict:
+    """Lower-cased header dict for canonicalization. SigV4 requires
+    repeated headers to be comma-joined (after whitespace folding), so a
+    multidict source (aiohttp CIMultiDict) must not collapse to the last
+    value — a client legitimately signing a duplicated header (repeated
+    x-amz-meta-*) would get a spurious SignatureDoesNotMatch."""
+    if hasattr(headers, "getall"):
+        out: dict = {}
+        for k in headers.keys():
+            lk = k.lower()
+            if lk not in out:
+                out[lk] = ",".join(
+                    " ".join(v.split()) for v in headers.getall(k))
+        return out
     return {k.lower(): v for k, v in headers.items()}
 
 
@@ -285,6 +313,13 @@ class AwsChunkedDecoder:
         except ValueError:
             raise AuthError("IncompleteBody",
                             f"bad chunk header {header[:40]!r}") from None
+        if size < 0 or size > MAX_CHUNK_SIZE:
+            # the declared size is buffered via readexactly before its
+            # signature can be checked; an attacker-controlled multi-GB
+            # claim must not force unbounded gateway memory (streaming
+            # bodies bypass aiohttp's client_max_size)
+            raise AuthError("InvalidRequest",
+                            f"chunk size {size} exceeds {MAX_CHUNK_SIZE}")
         sig = ""
         for kv in rest.split(";"):
             if kv.startswith("chunk-signature="):

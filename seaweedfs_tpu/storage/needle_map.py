@@ -21,7 +21,36 @@ from typing import Callable, Iterator
 
 from . import types as t
 
-_ENTRY = struct.Struct(">QII")  # key, offset/8, size
+_ENTRY4 = struct.Struct(">QII")  # key, offset/8, size (4-byte offsets)
+
+
+def pack_entry(key: int, actual_offset: int, size: int) -> bytes:
+    """One .idx/.ecx entry at the current offset width (idx/walk.go:44;
+    5-byte layout per offset_5bytes.go)."""
+    units = actual_offset // t.NEEDLE_PADDING_SIZE
+    if units >= 1 << (8 * t.OFFSET_SIZE):
+        raise OverflowError(
+            f"offset {actual_offset} exceeds the {t.OFFSET_SIZE}-byte "
+            f"index limit ({t.max_volume_size()} bytes); "
+            f"use set_offset_size(5) / SWTPU_OFFSET_BYTES=5")
+    if t.OFFSET_SIZE == 4:
+        return _ENTRY4.pack(key, units, size)
+    return (key.to_bytes(t.NEEDLE_ID_SIZE, "big")
+            + units.to_bytes(t.OFFSET_SIZE, "big")
+            + size.to_bytes(t.SIZE_SIZE, "big"))
+
+
+def unpack_entry(blob: bytes, pos: int = 0) -> tuple[int, int, int]:
+    """-> (key, actual_offset, size) from one entry at `pos`."""
+    if t.OFFSET_SIZE == 4:
+        key, units, size = _ENTRY4.unpack_from(blob, pos)
+    else:
+        key = int.from_bytes(blob[pos:pos + t.NEEDLE_ID_SIZE], "big")
+        p = pos + t.NEEDLE_ID_SIZE
+        units = int.from_bytes(blob[p:p + t.OFFSET_SIZE], "big")
+        p += t.OFFSET_SIZE
+        size = int.from_bytes(blob[p:p + t.SIZE_SIZE], "big")
+    return key, units * t.NEEDLE_PADDING_SIZE, size
 
 
 @dataclass
@@ -32,11 +61,10 @@ class NeedleValue:
 
 
 def walk_index_blob(blob: bytes) -> Iterator[tuple[int, int, int]]:
-    """Yield (key, actual_offset, size) for each 16B entry (idx/walk.go:12)."""
+    """Yield (key, actual_offset, size) per entry (idx/walk.go:12)."""
     n = len(blob) // t.NEEDLE_MAP_ENTRY_SIZE
     for i in range(n):
-        key, off, size = _ENTRY.unpack_from(blob, i * t.NEEDLE_MAP_ENTRY_SIZE)
-        yield key, off * t.NEEDLE_PADDING_SIZE, size
+        yield unpack_entry(blob, i * t.NEEDLE_MAP_ENTRY_SIZE)
 
 
 def walk_index_file(path: str,
@@ -105,8 +133,7 @@ class MemoryNeedleMap:
 
     def _log(self, key: int, offset: int, size: int) -> None:
         if self._idx is not None:
-            self._idx.write(_ENTRY.pack(
-                key, offset // t.NEEDLE_PADDING_SIZE, size))
+            self._idx.write(pack_entry(key, offset, size))
             self._idx.flush()
 
     # -- NeedleMapper API --
@@ -179,7 +206,16 @@ class _NativeMapAdapter:
             self._zero = val
             return
         assert val.offset % t.NEEDLE_PADDING_SIZE == 0, val.offset
-        self._nm.set(key, val.offset // t.NEEDLE_PADDING_SIZE, val.size)
+        units = val.offset // t.NEEDLE_PADDING_SIZE
+        if units > 0xFFFFFFFF:
+            # the native store's offset field is uint32; ctypes would
+            # silently truncate and later reads would return the wrong
+            # needle (silent corruption) — refuse loudly instead
+            raise OverflowError(
+                f"needle offset {val.offset} exceeds the native compact "
+                f"map's 32 GiB range; use -index memory/disk for volumes "
+                f"above 32 GiB")
+        self._nm.set(key, units, val.size)
 
     def __len__(self) -> int:
         return len(self._nm) + (1 if self._zero is not None else 0)
@@ -305,9 +341,13 @@ def best_needle_map(index_path: str | None = None,
     if kind == "disk":
         return DiskNeedleMap(index_path)
     if kind == "compact":
+        if t.OFFSET_SIZE != 4:
+            raise ValueError(
+                "the native compact map stores 32-bit offsets and cannot "
+                "index 5-byte-offset volumes; use -index memory/disk")
         return CompactNeedleMap(index_path)
     from ..native import needle_map as native_nm
-    if native_nm.available():
+    if native_nm.available() and t.OFFSET_SIZE == 4:
         return CompactNeedleMap(index_path)
     return MemoryNeedleMap(index_path)
 
@@ -331,8 +371,7 @@ class SortedFileNeedleMap:
 
     def _entry(self, i: int) -> tuple[int, int, int]:
         self._f.seek(i * t.NEEDLE_MAP_ENTRY_SIZE)
-        key, off, size = _ENTRY.unpack(self._f.read(t.NEEDLE_MAP_ENTRY_SIZE))
-        return key, off * t.NEEDLE_PADDING_SIZE, size
+        return unpack_entry(self._f.read(t.NEEDLE_MAP_ENTRY_SIZE))
 
     def locate(self, key: int) -> int | None:
         """Entry index of key, or None."""
@@ -390,4 +429,4 @@ def write_sorted_index(entries: list[tuple[int, int, int]], path: str) -> None:
     with open(path, "wb") as f:
         for key in sorted(latest):
             off, size = latest[key]
-            f.write(_ENTRY.pack(key, off // t.NEEDLE_PADDING_SIZE, size))
+            f.write(pack_entry(key, off, size))

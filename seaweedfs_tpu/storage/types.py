@@ -16,6 +16,12 @@ from dataclasses import dataclass
 COOKIE_SIZE = 4
 NEEDLE_ID_SIZE = 8
 SIZE_SIZE = 4
+# Needle-offset width in .idx/.ecx entries. 4 bytes (units of 8) caps a
+# volume at 32 GiB; 5 bytes at 8 TiB. The reference switches this with the
+# `5BytesOffset` build tag (offset_5bytes.go:14-16, Makefile:16) — i.e. a
+# process-wide constant, because every index entry in the store shares one
+# width. Here it is a runtime switch: set_offset_size(5), or
+# SWTPU_OFFSET_BYTES=5 in the environment (read by the CLI at startup).
 OFFSET_SIZE = 4
 NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
 NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
@@ -23,6 +29,26 @@ TIMESTAMP_SIZE = 8
 NEEDLE_PADDING_SIZE = 8
 NEEDLE_CHECKSUM_SIZE = 4
 TOMBSTONE_FILE_SIZE = 0xFFFFFFFF
+
+
+def max_volume_size() -> int:
+    """Largest addressable byte offset + 1 for the current offset width
+    (offset_4bytes.go: 32GB; offset_5bytes.go:14-16: 8TB)."""
+    return (1 << (8 * OFFSET_SIZE)) * NEEDLE_PADDING_SIZE
+
+
+def set_offset_size(n: int) -> None:
+    """Switch the process-wide index entry offset width (4 or 5 bytes).
+
+    Must be called before any volume/index is opened: mixing widths in one
+    process would mis-parse every existing entry, exactly like linking a
+    5BytesOffset build against a 4-byte .idx in the reference.
+    """
+    if n not in (4, 5):
+        raise ValueError(f"offset size must be 4 or 5, got {n}")
+    global OFFSET_SIZE, NEEDLE_MAP_ENTRY_SIZE
+    OFFSET_SIZE = n
+    NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + n + SIZE_SIZE
 
 # Needle format versions (weed/storage/needle/volume_version.go)
 VERSION1 = 1
@@ -36,14 +62,20 @@ def random_cookie() -> int:
 
 
 def offset_to_bytes(actual_offset: int) -> bytes:
-    """actual byte offset -> 4-byte stored offset (units of 8 bytes)."""
+    """actual byte offset -> stored offset (units of 8 bytes, current
+    width). Raises instead of silently wrapping past the volume cap."""
     assert actual_offset % NEEDLE_PADDING_SIZE == 0, actual_offset
-    return (actual_offset // NEEDLE_PADDING_SIZE).to_bytes(4, "big")
+    units = actual_offset // NEEDLE_PADDING_SIZE
+    if units >= 1 << (8 * OFFSET_SIZE):
+        raise OverflowError(
+            f"offset {actual_offset} exceeds the {OFFSET_SIZE}-byte index "
+            f"limit ({max_volume_size()} bytes); use set_offset_size(5)")
+    return units.to_bytes(OFFSET_SIZE, "big")
 
 
 def offset_from_bytes(b: bytes) -> int:
-    """4-byte stored offset -> actual byte offset."""
-    return int.from_bytes(b[:4], "big") * NEEDLE_PADDING_SIZE
+    """Stored offset (current width) -> actual byte offset."""
+    return int.from_bytes(b[:OFFSET_SIZE], "big") * NEEDLE_PADDING_SIZE
 
 
 def padding_length(needle_size: int, version: int) -> int:

@@ -15,12 +15,9 @@ import time
 
 from . import types as t
 from .needle import Needle
-from .needle_map import _ENTRY, walk_index_blob
+from .needle_map import pack_entry, walk_index_blob
 from .super_block import SuperBlock
 from .volume import Volume
-
-_IDX_ENTRY = _ENTRY
-
 
 class VacuumError(Exception):
     pass
@@ -61,8 +58,7 @@ def compact(v: Volume) -> None:
             if n.has_expired(now):
                 continue
             dst.write(blob)
-            idx.write(_IDX_ENTRY.pack(
-                key, new_offset // t.NEEDLE_PADDING_SIZE, nv.size))
+            idx.write(pack_entry(key, new_offset, nv.size))
             new_offset += blob_len
             throttle.maybe_sleep(blob_len)
 
@@ -154,9 +150,8 @@ def _makeup_diff(v: Volume, new_dat: str, new_idx: str,
             if off > 0 and size not in (0, t.TOMBSTONE_FILE_SIZE):
                 src.seek(off)
                 dst.write(src.read(t.actual_size(size, v.version)))
-                idx.write(_IDX_ENTRY.pack(
-                    key, pos // t.NEEDLE_PADDING_SIZE, size))
+                idx.write(pack_entry(key, pos, size))
             else:
                 tomb = Needle(cookie=0x12345678, id=key)
                 dst.write(tomb.to_bytes(v.version))
-                idx.write(_IDX_ENTRY.pack(key, 0, t.TOMBSTONE_FILE_SIZE))
+                idx.write(pack_entry(key, 0, t.TOMBSTONE_FILE_SIZE))

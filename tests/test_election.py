@@ -197,3 +197,44 @@ async def _body_failover(tmp_path):
             await vs.stop()
         for m in masters:
             await m.stop()
+
+
+def test_vote_state_survives_restart(tmp_path):
+    """A restarted master must not grant a second vote in a term it
+    already voted in (durable term/votedFor, raft_server.go:60-76)."""
+    from seaweedfs_tpu.master.election import Election
+
+    path = str(tmp_path / "raft_state.json")
+    peers = ["a:1", "b:2", "c:3"]
+    e1 = Election("a:1", peers, state_path=path)
+    r = e1.on_vote_request(term=5, candidate="b:2", max_volume_id=10)
+    assert r["granted"] and e1.term == 5
+
+    # crash + restart: state reloads from disk
+    e2 = Election("a:1", peers, state_path=path)
+    assert e2.term == 5
+    assert e2.voted_for == "b:2"
+    # a competing candidate in the SAME term must be refused
+    r = e2.on_vote_request(term=5, candidate="c:3", max_volume_id=10)
+    assert not r["granted"]
+    # re-voting for the same candidate stays idempotent
+    r = e2.on_vote_request(term=5, candidate="b:2", max_volume_id=10)
+    assert r["granted"]
+    # a HIGHER term resets votedFor and persists the new term
+    r = e2.on_vote_request(term=6, candidate="c:3", max_volume_id=10)
+    assert r["granted"]
+    e3 = Election("a:1", peers, state_path=path)
+    assert e3.term == 6 and e3.voted_for == "c:3"
+
+
+def test_corrupt_election_state_is_fatal(tmp_path):
+    from seaweedfs_tpu.master.election import Election
+
+    path = str(tmp_path / "raft_state.json")
+    with open(path, "w") as f:
+        f.write("{not json")
+    try:
+        Election("a:1", ["a:1", "b:2"], state_path=path)
+        raise AssertionError("corrupt state silently ignored")
+    except SystemExit:
+        pass

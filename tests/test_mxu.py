@@ -1,0 +1,106 @@
+"""MXU GF(2) bit-matmul path (ops/gf256_mxu.py) vs the CPU oracle.
+
+bench.py races this formulation against the VPU Pallas kernel on the real
+chip; these tests pin its correctness off-chip (plain XLA, runs on the CPU
+backend) so a fast-but-wrong path can never win the race. Contract under
+test: klauspost Encode/Reconstruct semantics
+(/root/reference/weed/storage/erasure_coding/ec_encoder.go:192,264).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ec import gf
+from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
+from seaweedfs_tpu.ops.gf256_mxu import mxu_words_transform
+from seaweedfs_tpu.ops.gf256_pallas import bytes_to_words, words_to_bytes
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(21)
+
+
+def _run(coeff, byte_rows, n, block_bm=8):
+    words = [bytes_to_words(b, block_bm=block_bm) for b in byte_rows]
+    outs = mxu_words_transform(np.asarray(coeff, np.uint8), words)
+    return [words_to_bytes(np.asarray(o), n) for o in outs]
+
+
+def test_mxu_encode_matches_cpu(rng):
+    cpu = CpuEncoder(use_native=False)
+    for n in (512, 4096, 128 * 1024):  # one and many (wm,128) word rows
+        data = [rng.integers(0, 256, n).astype(np.uint8) for _ in range(10)]
+        want = cpu.encode(list(data))[10:]
+        got = _run(gf.parity_matrix(), data, n)
+        for p in range(4):
+            assert np.array_equal(got[p], want[p]), (n, p)
+
+
+def test_mxu_encode_unaligned_padding(rng):
+    """n not a multiple of the word-block quantum: zero padding must not
+    perturb the live prefix (GF transform is elementwise over bytes)."""
+    cpu = CpuEncoder(use_native=False)
+    n = 1000
+    data = [rng.integers(0, 256, n).astype(np.uint8) for _ in range(10)]
+    want = cpu.encode(list(data))[10:]
+    got = _run(gf.parity_matrix(), data, n)
+    for p in range(4):
+        assert np.array_equal(got[p], want[p]), p
+
+
+def test_mxu_rebuild_coeffs(rng):
+    """Rebuild matrices: worst-case 4 data shards lost, mixed losses, and
+    a single-row reconstruct — the shapes store_ec.go:322 generates."""
+    cpu = CpuEncoder(use_native=False)
+    n = 2048
+    shards = cpu.encode([rng.integers(0, 256, n).astype(np.uint8)
+                         for _ in range(10)])
+    cases = [
+        ([0, 1, 2, 3], list(range(4, 14))),
+        ([0, 5, 11, 13], [1, 2, 3, 4, 6, 7, 8, 9, 10, 12]),
+        ([7], [0, 1, 2, 3, 4, 5, 6, 8, 9, 10]),
+    ]
+    for want_rows, present in cases:
+        coeff = gf.shard_rows(want_rows, present)
+        got = _run(coeff, [shards[i] for i in present], n)
+        for j, sid in enumerate(want_rows):
+            assert np.array_equal(got[j], shards[sid]), (want_rows, sid)
+
+
+def test_mxu_multiple_wm_blocks(rng):
+    """Several grid blocks with the default block quantum (the shape the
+    bench times)."""
+    cpu = CpuEncoder(use_native=False)
+    n = 384 * 1024  # wm=768 -> 3 blocks at block_bm=256
+    data = [rng.integers(0, 256, n).astype(np.uint8) for _ in range(10)]
+    want = cpu.encode(list(data))[10:]
+    got = _run(gf.parity_matrix(), data, n, block_bm=256)
+    for p in range(4):
+        assert np.array_equal(got[p], want[p]), p
+
+
+def test_pipeline_with_mxu_method(rng, tmp_path, monkeypatch):
+    """SWTPU_EC_METHOD=mxu drives the whole file pipeline through the MXU
+    formulation (pipeline.py branch) and must produce identical shards."""
+    from seaweedfs_tpu.ec import pipeline as pl
+    from seaweedfs_tpu.ec.encoder_jax import JaxEncoder
+
+    n = 40960
+    base_cpu = str(tmp_path / "c")
+    base_mxu = str(tmp_path / "m")
+    payload = rng.integers(0, 256, n).astype(np.uint8).tobytes()
+    for b in (base_cpu, base_mxu):
+        with open(b + ".dat", "wb") as f:
+            f.write(payload)
+    pl.write_ec_files(base_cpu, encoder=pl.get_encoder("cpu"),
+                      large_block=4096, small_block=512, buffer_size=512)
+    monkeypatch.setenv("SWTPU_EC_METHOD", "mxu")
+    pl.write_ec_files(base_mxu, encoder=JaxEncoder(use_pallas=False),
+                      large_block=4096, small_block=512, buffer_size=512)
+    for i in range(14):
+        with open(base_cpu + pl.to_ext(i), "rb") as a, \
+                open(base_mxu + pl.to_ext(i), "rb") as b:
+            assert a.read() == b.read(), i

@@ -1,0 +1,129 @@
+"""4/5-byte offset widths (reference: storage/types/offset_5bytes.go,
+Makefile:16 `5BytesOffset` build tag) and the silent-wrap guards around
+the 32 GiB boundary."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle_map import (MemoryNeedleMap, NeedleValue,
+                                              pack_entry, unpack_entry,
+                                              walk_index_blob,
+                                              write_sorted_index,
+                                              SortedFileNeedleMap)
+
+
+@pytest.fixture
+def five_byte():
+    t.set_offset_size(5)
+    yield
+    t.set_offset_size(4)
+
+
+def test_defaults_match_reference_4byte_layout():
+    assert t.OFFSET_SIZE == 4
+    assert t.NEEDLE_MAP_ENTRY_SIZE == 16
+    assert t.max_volume_size() == 32 * 1024 ** 3
+
+
+def test_4byte_offset_overflow_raises():
+    """Past 32 GiB the 4-byte width must refuse, not wrap (wrapping maps
+    reads to the wrong needle — silent corruption)."""
+    with pytest.raises(OverflowError, match="set_offset_size"):
+        t.offset_to_bytes(32 * 1024 ** 3)
+    # largest representable offset still round-trips
+    top = 32 * 1024 ** 3 - 8
+    assert t.offset_from_bytes(t.offset_to_bytes(top)) == top
+
+
+def test_5byte_entry_roundtrip_past_32gb(five_byte):
+    """Synthetic >32 GiB offsets round-trip through the 17-byte entry
+    (offset_5bytes.go:14-16: 8 TB volumes)."""
+    assert t.NEEDLE_MAP_ENTRY_SIZE == 17
+    assert t.max_volume_size() == 8 * 1024 ** 4
+    for off in (0, 8, 32 * 1024 ** 3, 5 * 1024 ** 4, 8 * 1024 ** 4 - 8):
+        blob = pack_entry(0x1234, off, 777)
+        assert len(blob) == 17
+        key, got_off, size = unpack_entry(blob)
+        assert (key, got_off, size) == (0x1234, off, 777)
+    with pytest.raises(OverflowError):
+        t.offset_to_bytes(8 * 1024 ** 4)
+
+
+def test_5byte_idx_log_and_walk(five_byte, tmp_path):
+    """MemoryNeedleMap .idx append log + reload with 5-byte entries,
+    including an offset far past the 4-byte range."""
+    path = str(tmp_path / "v.idx")
+    nm = MemoryNeedleMap(path)
+    big = 40 * 1024 ** 3  # > 32 GiB
+    nm.put(1, 8, 100)
+    nm.put(2, big, 200)
+    nm.delete(1, big + 1024)
+    nm.close()
+
+    nm2 = MemoryNeedleMap(path)
+    assert nm2.get(2).offset == big
+    assert nm2.get(1).size == t.TOMBSTONE_FILE_SIZE
+    entries = list(walk_index_blob(open(path, "rb").read()))
+    assert entries[1] == (2, big, 200)
+    nm2.close()
+
+
+def test_5byte_sorted_index(five_byte, tmp_path):
+    path = str(tmp_path / "v.ecx")
+    big = 100 * 1024 ** 3
+    write_sorted_index([(7, big, 50), (3, 16, 20)], path)
+    sm = SortedFileNeedleMap(path)
+    assert sm.get(7).offset == big
+    assert sm.get(3).offset == 16
+    assert sm.get(99) is None
+    sm.close()
+
+
+def test_native_compact_map_refuses_past_32gb():
+    """ADVICE: the native uint32 store must raise instead of letting
+    ctypes silently truncate offsets past 32 GiB."""
+    from seaweedfs_tpu.native import needle_map as native_nm
+
+    if not native_nm.available():
+        pytest.skip("native map not built")
+    from seaweedfs_tpu.storage.needle_map import _NativeMapAdapter
+
+    ad = _NativeMapAdapter()
+    ad[1] = NeedleValue(1, 8, 10)
+    assert ad.get(1).offset == 8
+    with pytest.raises(OverflowError, match="32 GiB"):
+        ad[2] = NeedleValue(2, 33 * 1024 ** 3, 10)
+    ad.close()
+
+
+def test_best_needle_map_5byte_avoids_native(five_byte):
+    from seaweedfs_tpu.storage.needle_map import (CompactNeedleMap,
+                                                  best_needle_map)
+
+    nm = best_needle_map(kind="auto")
+    assert not isinstance(nm, CompactNeedleMap)
+    nm.close()
+    with pytest.raises(ValueError, match="5-byte"):
+        best_needle_map(kind="compact")
+
+
+def test_volume_roundtrip_with_5byte_offsets(five_byte, tmp_path):
+    """A whole volume written/read under the 5-byte width (same data
+    path, wider index entries)."""
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    d = str(tmp_path)
+    v = Volume(d, "", 9)
+    rng = np.random.default_rng(5)
+    blobs = {i: rng.integers(0, 256, 100 + i).astype(np.uint8).tobytes()
+             for i in range(1, 20)}
+    for i, data in blobs.items():
+        v.write_needle(Needle(cookie=i, id=i, data=data))
+    v.close()
+
+    v2 = Volume(d, "", 9, create_if_missing=False)
+    for i, data in blobs.items():
+        assert v2.read_needle(i, cookie=i).data == data
+    v2.close()

@@ -190,3 +190,95 @@ def test_s3_gateway_enforces_auth(tmp_path):
                     assert resp.status == 403, await resp.text()
 
     run(body())
+
+
+def test_presigned_expires_bounds():
+    """X-Amz-Expires outside 1..604800 and far-future X-Amz-Date are
+    rejected (AWS bounds presigned lifetime to 7 days)."""
+    v = SigV4Verifier({AK: SK})
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    date = amz_date[:8]
+
+    def q(**over):
+        qd = {"X-Amz-Algorithm": ALGORITHM,
+              "X-Amz-Credential": f"{AK}/{date}/{REGION}/s3/aws4_request",
+              "X-Amz-Date": amz_date, "X-Amz-Expires": "300",
+              "X-Amz-SignedHeaders": "host", "X-Amz-Signature": "00"}
+        qd.update(over)
+        return qd
+
+    for bad in ("0", "-5", "604801", "99999999"):
+        try:
+            v.verify("GET", "/b/k", q(**{"X-Amz-Expires": bad}),
+                     {"host": "h:1"}, None)
+            raise AssertionError(f"accepted X-Amz-Expires={bad}")
+        except AuthError as e:
+            assert e.code == "AuthorizationQueryParametersError", bad
+
+    future = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime(time.time() + 3600))
+    try:
+        v.verify("GET", "/b/k", q(**{"X-Amz-Date": future}),
+                 {"host": "h:1"}, None)
+        raise AssertionError("accepted far-future X-Amz-Date")
+    except AuthError as e:
+        assert e.code == "RequestTimeTooSkewed"
+
+
+def test_chunked_size_cap():
+    """A client-declared multi-GB chunk must be refused before buffering
+    (bounds gateway memory; streaming bypasses client_max_size)."""
+    huge = (b"40000000;chunk-signature=aaaa\r\n")
+    try:
+        decode_aws_chunked(huge)
+        raise AssertionError("accepted oversized chunk claim")
+    except AuthError as e:
+        assert e.code == "InvalidRequest"
+    # boundary: a legitimate large-ish chunk still decodes
+    ok = (b"5;chunk-signature=aaaa\r\nhello\r\n"
+          b"0;chunk-signature=cccc\r\n\r\n")
+    assert decode_aws_chunked(ok) == b"hello"
+
+
+def test_multivalue_header_canonicalization():
+    """Repeated headers must comma-join in the canonical form (SigV4 spec)
+    instead of collapsing to the last value."""
+    from multidict import CIMultiDict
+
+    from seaweedfs_tpu.s3.auth import _lower_headers
+
+    md = CIMultiDict()
+    md.add("X-Amz-Meta-Tag", "  a  b ")
+    md.add("x-amz-meta-tag", "c")
+    md.add("Host", "h:1")
+    low = _lower_headers(md)
+    assert low["x-amz-meta-tag"] == "a b,c"
+    assert low["host"] == "h:1"
+
+    # end-to-end: sign WITH the comma-joined value, verify with the
+    # multidict carrying the duplicated header
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    date = amz_date[:8]
+    headers = {"host": "h:1", "x-amz-date": amz_date,
+               "x-amz-content-sha256": UNSIGNED,
+               "x-amz-meta-tag": "a b,c"}
+    signed = sorted(headers)
+    canon = "\n".join([
+        "GET", "/b/k", "",
+        "".join(f"{h}:{headers[h]}\n" for h in signed),
+        ";".join(signed), UNSIGNED])
+    scope = f"{date}/{REGION}/s3/aws4_request"
+    sts = "\n".join([ALGORITHM, amz_date, scope,
+                     hashlib.sha256(canon.encode()).hexdigest()])
+    sig = hmac.new(signing_key(SK, date, REGION), sts.encode(),
+                   hashlib.sha256).hexdigest()
+    wire = CIMultiDict()
+    wire.add("host", "h:1")
+    wire.add("x-amz-date", amz_date)
+    wire.add("x-amz-content-sha256", UNSIGNED)
+    wire.add("X-Amz-Meta-Tag", "  a  b ")
+    wire.add("X-Amz-Meta-Tag", "c")
+    wire.add("Authorization",
+             f"{ALGORITHM} Credential={AK}/{scope}, "
+             f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+    v = SigV4Verifier({AK: SK})
+    assert v.verify("GET", "/b/k", {}, wire, None).access_key == AK
