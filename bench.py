@@ -274,6 +274,33 @@ def child_main() -> None:
         if enc > 0 and reb > 0:  # "value" only once BOTH ops are measured
             stage_res["value"] = min(enc, reb)
         _emit(stage_res)
+
+    # batched rack-encode config (BASELINE.json 64-volume shape scaled to
+    # one chip): V volumes in one launch through the mesh "vol" axis
+    if left() > 25:
+        try:
+            from seaweedfs_tpu.parallel import mesh as pmesh
+
+            m = pmesh.make_mesh(jax.devices()[:1])
+            vb, nb = (8, 8 << 20) if backend == "tpu" else (4, 256 << 10)
+            nb = min(nb, max_bytes)
+            mk = jax.jit(lambda key: jax.random.randint(
+                key, (vb, k, nb), 0, 256, jnp.uint8))
+            vol_data = mk(jax.random.PRNGKey(1))
+            jax.block_until_ready(vol_data)
+            out = pmesh.batched_encode(m, vol_data)
+            jax.block_until_ready(out)  # compile
+            t0 = time.perf_counter()
+            iters = 2
+            for _ in range(iters):
+                jax.block_until_ready(pmesh.batched_encode(m, vol_data))
+            dt = (time.perf_counter() - t0) / iters - rtt
+            gbs = vb * k * nb / max(dt, 1e-9) / 1e9
+            _log(f"batched encode {vb}x{nb >> 20}MB: {gbs:.2f} GB/s")
+            _emit({"stage": "batched", "batched_encode_GBps": round(gbs, 2)})
+        except Exception as e:  # noqa: BLE001
+            _emit({"stage": "batched",
+                   "batched_encode_error": str(e)[:200]})
     _emit({"stage": "done", "backend": backend})
 
 
@@ -387,7 +414,8 @@ def main() -> None:
         result["backend"] = merged.get("backend", "unknown")
         result["value"] = round(float(merged["value"]), 2)
         for key in ("encode_GBps", "rebuild4_GBps", "paths",
-                    "paths_verified"):
+                    "paths_verified", "batched_encode_GBps",
+                    "batched_encode_error"):
             if key in merged:
                 result[key] = merged[key]
         if cpu_gbs > 0:

@@ -836,6 +836,13 @@ def main(argv: list[str] | None = None) -> None:
     if env_off:
         from .storage import types as _types
         _types.set_offset_size(int(env_off))
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # the axon sitecustomize force-registers the TPU tunnel and
+        # IGNORES the JAX_PLATFORMS env var; only jax.config wins at
+        # backend-init time. Without this, an explicit cpu request still
+        # dials the tunnel and an EC endpoint can hang in backend init.
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     if hasattr(args, "verbosity"):
         from .util import glog
         glog.init(verbosity=args.verbosity,

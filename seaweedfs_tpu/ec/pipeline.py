@@ -132,6 +132,108 @@ def _encode_one_block_row(f, start: int, block_size: int, buf_size: int,
             outs[gf.DATA_SHARDS + p].write(np.asarray(buf, np.uint8).tobytes())
 
 
+def write_ec_files_batched(base_names: list[str], encoder=None,
+                           large_block: int = LARGE_BLOCK_SIZE,
+                           small_block: int = SMALL_BLOCK_SIZE,
+                           buffer_size: int = 8 * 1024 * 1024,
+                           batch_volumes: int = 8) -> None:
+    """Stripe SEVERAL volumes' .dat files with batched kernel launches —
+    the rack-encode configuration (BASELINE.json 64x30GB; reference
+    encodes volumes serially, command_ec_encode.go:89).
+
+    The GF transform is independent per byte column, so equal-length
+    buffer groups from DIFFERENT volumes concatenate into one stream per
+    shard position: one kernel launch then carries up to
+    batch_volumes x 10 x buffer_size bytes. This is the single-chip
+    expression of parallel/mesh.py's "vol" axis; on a multi-chip mesh the
+    same batch shards over devices.
+
+    Parity buffers surface in flush order, not stream order, so every
+    parity write lands at an explicitly recorded shard offset.
+    """
+    encoder = encoder or get_encoder()
+    parity = gf.parity_matrix()
+    outs: dict[str, list] = {}
+    # buf_len -> list of (data_buffers, base, parity_shard_offset)
+    pending: dict[int, list] = {}
+    pending_refs: dict[str, int] = {}   # base -> unflushed group count
+    fully_enqueued: set[str] = set()
+
+    def maybe_close(base: str) -> None:
+        # bound open fds: at most batch_volumes in-flight volumes keep
+        # their 14 shard files open (a 64-volume rack batch would
+        # otherwise hold ~900 fds past the default 1024 soft limit)
+        if base in fully_enqueued and pending_refs.get(base, 0) == 0:
+            for f in outs.pop(base, []):
+                f.close()
+
+    def flush(buf_len: int) -> None:
+        group = pending.pop(buf_len, [])
+        if not group:
+            return
+        cat = [np.concatenate([g[0][i] for g in group])
+               if len(group) > 1 else group[0][0][i]
+               for i in range(gf.DATA_SHARDS)]
+        parities = _transform_buffers(encoder, parity, cat)
+        off = 0
+        for buffers, base, shard_off in group:
+            ln = len(buffers[0])
+            for p, pbuf in enumerate(parities):
+                f = outs[base][gf.DATA_SHARDS + p]
+                f.seek(shard_off)
+                f.write(np.asarray(pbuf[off:off + ln], np.uint8).tobytes())
+            off += ln
+            pending_refs[base] -= 1
+            maybe_close(base)
+
+    try:
+        for base in base_names:
+            dat_path = base + ".dat"
+            dat_size = os.path.getsize(dat_path)
+            outs[base] = [open(base + to_ext(i), "wb")
+                          for i in range(gf.TOTAL_SHARDS)]
+            shard_pos = 0
+            with open(dat_path, "rb") as f:
+                remaining = dat_size
+                processed = 0
+                large_row = large_block * gf.DATA_SHARDS
+                rows: list[tuple[int, int]] = []
+                while remaining > large_row:
+                    rows.append((processed, large_block))
+                    processed += large_row
+                    remaining -= large_row
+                while remaining > 0:
+                    rows.append((processed, small_block))
+                    processed += small_block * gf.DATA_SHARDS
+                    remaining -= small_block * gf.DATA_SHARDS
+                for start, block_size in rows:
+                    buf = min(buffer_size, block_size)
+                    assert block_size % buf == 0, (block_size, buf)
+                    for b in range(block_size // buf):
+                        buffers = []
+                        for i in range(gf.DATA_SHARDS):
+                            f.seek(start + block_size * i + b * buf)
+                            raw = f.read(buf)
+                            if len(raw) < buf:
+                                raw += b"\x00" * (buf - len(raw))
+                            buffers.append(np.frombuffer(raw, np.uint8))
+                            outs[base][i].write(raw)
+                        pending.setdefault(buf, []).append(
+                            (buffers, base, shard_pos))
+                        pending_refs[base] = pending_refs.get(base, 0) + 1
+                        shard_pos += buf
+                        if len(pending[buf]) >= batch_volumes:
+                            flush(buf)
+            fully_enqueued.add(base)
+            maybe_close(base)
+        for buf_len in list(pending):
+            flush(buf_len)
+    finally:
+        for fs in outs.values():
+            for f in fs:
+                f.close()
+
+
 def write_sorted_file_from_idx(base_name: str,
                                ext: str = ".ecx") -> None:
     """<base>.idx -> sorted <base>.ecx (WriteSortedFileFromIdx,
@@ -218,6 +320,34 @@ def write_dat_file(base_name: str, dat_size: int,
     finally:
         for f in ins:
             f.close()
+
+
+def write_idx_file_from_ec_index(base_name: str) -> None:
+    """<base>.ecx (+ .ecj tombstone replay) -> <base>.idx
+    (WriteIdxFileFromEcIndex, ec_decoder.go:17-42). Entries copy over
+    as-is (tombstoned ones keep their TOMBSTONE size); any unfolded .ecj
+    keys are appended as delete entries so the rebuilt needle map agrees
+    with the EC delete journal."""
+    from ..storage.needle_map import pack_entry
+    with open(base_name + ".ecx", "rb") as f:
+        # tombstoned .ecx entries keep their original offset (in-place
+        # MarkNeedleDeleted), but the reassembled .dat is truncated to the
+        # live extent (FindDatFileSize skips deletes) — rewrite them to
+        # offset 0 like the reference's nm.Delete idx entries, or the
+        # loaded volume's integrity check would see an index entry past
+        # the data end
+        entries = [(key, 0 if size == t.TOMBSTONE_FILE_SIZE else off, size)
+                   for key, off, size in walk_index_blob(f.read())]
+    ecj_path = base_name + ".ecj"
+    if os.path.exists(ecj_path):
+        with open(ecj_path, "rb") as f:
+            j = f.read()
+        for i in range(len(j) // 8):
+            key = int.from_bytes(j[i * 8:(i + 1) * 8], "big")
+            entries.append((key, 0, t.TOMBSTONE_FILE_SIZE))
+    with open(base_name + ".idx", "wb") as f:
+        for key, off, size in entries:
+            f.write(pack_entry(key, off, size))
 
 
 def find_dat_file_size(base_name: str,

@@ -54,7 +54,10 @@ def batched_encode(mesh: Mesh, data: jax.Array) -> jax.Array:
 
     V is sharded over "vol", the byte columns n over "shard" (a
     sequence-parallel-style split: encode is columnwise independent, so both
-    axes shard with no collectives).
+    axes shard with no collectives). A ragged V (rack encode: more volumes
+    than devices with an uneven tail) is zero-padded to the vol-axis
+    quantum — padding encodes to garbage that is sliced off, costing one
+    extra volume-row per launch at worst.
     """
     consts = _encode_consts()
 
@@ -63,10 +66,16 @@ def batched_encode(mesh: Mesh, data: jax.Array) -> jax.Array:
         parity = _apply_bitplanes(consts, d)
         return jnp.concatenate([d, parity], axis=-2)
 
+    data = jnp.asarray(data, jnp.uint8)  # no-op for device-resident input
+    v = data.shape[0]
+    vol_dim = mesh.devices.shape[0]
+    padded = -(-v // vol_dim) * vol_dim
+    if padded != v:
+        data = jnp.pad(data, ((0, padded - v), (0, 0), (0, 0)))
     spec = NamedSharding(mesh, P("vol", None, "shard"))
-    data = jax.device_put(jnp.asarray(data, jnp.uint8), spec)
+    data = jax.device_put(data, spec)
     out = step(data)
-    return out
+    return out[:v] if padded != v else out
 
 
 def batched_rebuild(mesh: Mesh, present_rows: list[int],

@@ -75,11 +75,14 @@ class VolumeServer:
         app.router.add_post("/admin/vacuum/commit", self.h_vacuum_commit)
         app.router.add_post("/admin/vacuum/cleanup", self.h_vacuum_cleanup)
         app.router.add_post("/admin/ec/generate", self.h_ec_generate)
+        app.router.add_post("/admin/ec/generate_batch",
+                            self.h_ec_generate_batch)
         app.router.add_post("/admin/ec/rebuild", self.h_ec_rebuild)
         app.router.add_post("/admin/ec/mount", self.h_ec_mount)
         app.router.add_post("/admin/ec/unmount", self.h_ec_unmount)
         app.router.add_post("/admin/ec/copy", self.h_ec_copy)
         app.router.add_post("/admin/ec/delete_shards", self.h_ec_delete_shards)
+        app.router.add_post("/admin/ec/to_volume", self.h_ec_to_volume)
         app.router.add_get("/admin/ec/shard_read", self.h_ec_shard_read)
         app.router.add_get("/admin/file", self.h_admin_file)
         app.router.add_post("/admin/query", self.h_query)
@@ -810,6 +813,31 @@ class VolumeServer:
         await loop.run_in_executor(None, work)
         return web.json_response({"ok": True})
 
+    async def h_ec_generate_batch(self, req: web.Request) -> web.Response:
+        """Batched VolumeEcShardsGenerate over several local volumes: one
+        kernel launch carries buffer groups from every volume (the
+        rack-encode shape; pipeline.write_ec_files_batched)."""
+        vids = [int(x) for x in req.query["volumes"].split(",") if x]
+        collection = req.query.get("collection", "")
+        bases = []
+        for vid in vids:
+            v = self.store.volumes.get(vid)
+            base = v.file_name() if v else self._base_name(vid, collection)
+            if base is None:
+                return web.json_response(
+                    {"error": f"volume {vid} not found"}, status=404)
+            bases.append(base)
+        loop = asyncio.get_running_loop()
+
+        def work():
+            ecpl.write_ec_files_batched(
+                bases, large_block=self.store.ec_large_block,
+                small_block=self.store.ec_small_block)
+            for base in bases:
+                ecpl.write_sorted_file_from_idx(base)
+        await loop.run_in_executor(None, work)
+        return web.json_response({"ok": True, "volumes": vids})
+
     async def h_ec_rebuild(self, req: web.Request) -> web.Response:
         """VolumeEcShardsRebuild (volume_grpc_erasure_coding.go:70-97)."""
         vid = int(req.query["volume"])
@@ -884,11 +912,42 @@ class VolumeServer:
         shard_ids = [int(x) for x in q.get("shards", "").split(",") if x]
         base = self._base_name(vid, collection)
         if base:
-            for sid in shard_ids:
-                p = base + ecpl.to_ext(sid)
+            exts = [ecpl.to_ext(sid) for sid in shard_ids]
+            if q.get("ecx", "") == "1":  # full teardown (ec.decode)
+                exts += [".ecx", ".ecj"]
+            for ext in exts:
+                p = base + ext
                 if os.path.exists(p):
                     os.remove(p)
         return web.json_response({"ok": True})
+
+    async def h_ec_to_volume(self, req: web.Request) -> web.Response:
+        """VolumeEcShardsToVolume (volume_grpc_erasure_coding.go:350-379):
+        collected data shards + .ecx -> .dat + .idx on disk, ready for
+        /admin/volume/mount. The ec.decode shell command gathers the
+        shards here first (command_ec_decode.go)."""
+        vid = int(req.query["volume"])
+        collection = req.query.get("collection", "")
+        base = self._base_name(vid, collection)
+        if base is None:
+            return web.json_response({"error": f"ec volume {vid} not found"},
+                                     status=404)
+        loop = asyncio.get_running_loop()
+
+        def work():
+            dat_size = ecpl.find_dat_file_size(base)
+            ecpl.write_dat_file(base, dat_size,
+                                large_block=self.store.ec_large_block,
+                                small_block=self.store.ec_small_block)
+            ecpl.write_idx_file_from_ec_index(base)
+            return dat_size
+        try:
+            dat_size = await loop.run_in_executor(None, work)
+        except FileNotFoundError as e:
+            # a data shard is absent on this node: the caller must gather
+            # or rebuild shards 0..9 here first
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"ok": True, "dat_size": dat_size})
 
     async def h_ec_shard_read(self, req: web.Request) -> web.Response:
         """VolumeEcShardRead (volume_grpc_erasure_coding.go:254-320)."""

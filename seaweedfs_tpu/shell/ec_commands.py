@@ -52,22 +52,29 @@ async def collect_volume_ids_for_ec_encode(
 
 
 async def ec_encode_volume(env: CommandEnv, vid: int,
-                           collection: str = "") -> dict:
-    """doEcEncode for one volume (command_ec_encode.go:89-117)."""
-    # locate replicas
-    lookup = await env.master_get("/dir/lookup", volumeId=str(vid))
-    if "locations" not in lookup:
-        raise RuntimeError(f"volume {vid} not found")
-    locations = [l["url"] for l in lookup["locations"]]
+                           collection: str = "",
+                           generate: bool = True,
+                           locations: list[str] | None = None) -> dict:
+    """doEcEncode for one volume (command_ec_encode.go:89-117).
+
+    generate=False skips steps 1-2 (already done by the batched generate
+    in ec_encode); `locations` passes replica urls already looked up by
+    the caller."""
+    if locations is None:
+        lookup = await env.master_get("/dir/lookup", volumeId=str(vid))
+        if "locations" not in lookup:
+            raise RuntimeError(f"volume {vid} not found")
+        locations = [l["url"] for l in lookup["locations"]]
     source = locations[0]
 
-    # 1. mark readonly everywhere (:119)
-    for url in locations:
-        await env.node_post(url, "/admin/volume/readonly", volume=str(vid))
-
-    # 2. generate 14 shards + .ecx on the source (:139)
-    await env.node_post(source, "/admin/ec/generate", volume=str(vid),
-                        collection=collection)
+    if generate:
+        # 1. mark readonly everywhere (:119)
+        for url in locations:
+            await env.node_post(url, "/admin/volume/readonly",
+                                volume=str(vid))
+        # 2. generate 14 shards + .ecx on the source (:139)
+        await env.node_post(source, "/admin/ec/generate", volume=str(vid),
+                            collection=collection)
 
     # 3. spread shards across servers round-robin by free slots (:153-256)
     nodes = await collect_ec_nodes(env)
@@ -126,13 +133,38 @@ def balanced_ec_distribution(nodes: list[dict],
 async def ec_encode(env: CommandEnv, collection: str = "",
                     vids: list[int] | None = None,
                     fullness: float = 0.95) -> list[dict]:
-    """ec.encode command entry (command_ec_encode.go:55)."""
+    """ec.encode command entry (command_ec_encode.go:55).
+
+    Volumes co-located on one server generate their shards through ONE
+    batched device call (/admin/ec/generate_batch): the rack-encode shape
+    where the kernel launch amortises over every co-located volume
+    (parallel/mesh.py's "vol" axis; the reference loops serially)."""
     if vids is None:
         vids = await collect_volume_ids_for_ec_encode(
             env, collection, fullness=fullness)
+    # group volumes by their generating (first-replica) server
+    by_source: dict[str, list[int]] = {}
+    locations: dict[int, list[str]] = {}
+    for vid in vids:
+        lookup = await env.master_get("/dir/lookup", volumeId=str(vid))
+        if "locations" not in lookup:
+            raise RuntimeError(f"volume {vid} not found")
+        locations[vid] = [l["url"] for l in lookup["locations"]]
+        by_source.setdefault(locations[vid][0], []).append(vid)
+    for vid, urls in locations.items():
+        for url in urls:
+            await env.node_post(url, "/admin/volume/readonly",
+                                volume=str(vid))
+    await asyncio.gather(*(
+        env.node_post(source, "/admin/ec/generate_batch",
+                      volumes=",".join(map(str, svids)),
+                      collection=collection)
+        for source, svids in by_source.items()))
     results = []
     for vid in vids:
-        results.append(await ec_encode_volume(env, vid, collection))
+        results.append(await ec_encode_volume(env, vid, collection,
+                                              generate=False,
+                                              locations=locations[vid]))
     return results
 
 
@@ -203,6 +235,87 @@ async def ec_rebuild(env: CommandEnv, collection: str = "",
                             collection=info["collection"])
         results.append({"volume": vid, "rebuilt": rebuilt,
                         "node": rebuilder})
+    return results
+
+
+# ---------------------------------------------------------------------------
+# ec.decode (command_ec_decode.go): sealed EC volume -> normal volume
+# ---------------------------------------------------------------------------
+
+
+async def ec_decode_volume(env: CommandEnv, vid: int, info: dict) -> dict:
+    """doEcDecode for one volume (command_ec_decode.go:71-99): gather the
+    data shards on the holder with the most of them, reassemble
+    .dat/.idx there (VolumeEcShardsToVolume), mount it as a normal
+    volume, then tear down every EC shard."""
+    coll = info["collection"]
+    per_node: dict[str, set[int]] = {}
+    for sid, holders in info["shards"].items():
+        for url in holders:
+            per_node.setdefault(url, set()).add(sid)
+    if not per_node:
+        return {"volume": vid, "error": "no shard holders"}
+    # target = server already holding the most shards (collectEcShards)
+    target = max(per_node, key=lambda u: len(per_node[u]))
+    have = set(per_node[target])
+
+    # if any data shard exists nowhere, it must be rebuilt on the target
+    # (needs >=10 gathered shards); otherwise just copy the missing data
+    # shards over
+    absent_data = [s for s in range(gf.DATA_SHARDS)
+                   if s not in info["shards"]]
+    needed = (sorted(info["shards"]) if absent_data
+              else [s for s in range(gf.DATA_SHARDS)])
+    for sid in needed:
+        if absent_data and len(have) >= gf.DATA_SHARDS:
+            break  # rebuild needs only 10 gathered shards
+        if sid in have or sid not in info["shards"]:
+            continue
+        await env.node_post(target, "/admin/ec/copy", volume=str(vid),
+                            collection=coll,
+                            source=info["shards"][sid][0],
+                            shards=str(sid), copy_ecx="1")
+        have.add(sid)
+    if absent_data:
+        if len(have) < gf.DATA_SHARDS:
+            return {"volume": vid, "error":
+                    f"unrepairable: only {len(have)} shards"}
+        await env.node_post(target, "/admin/ec/rebuild", volume=str(vid),
+                            collection=coll)
+
+    # reassemble .dat/.idx (VolumeEcShardsToVolume)
+    await env.node_post(target, "/admin/ec/to_volume", volume=str(vid),
+                        collection=coll)
+    # mount the normal volume, then unmount + delete EC state everywhere
+    # (mountVolumeAndDeleteEcShards order: mount first, teardown after)
+    await env.node_post(target, "/admin/volume/mount", volume=str(vid),
+                        collection=coll)
+    all_shards = ",".join(map(str, range(gf.TOTAL_SHARDS)))
+    for url in per_node:
+        await env.node_post(url, "/admin/ec/unmount", volume=str(vid))
+        await env.node_post(url, "/admin/ec/delete_shards",
+                            volume=str(vid), collection=coll,
+                            shards=all_shards, ecx="1")
+    return {"volume": vid, "node": target}
+
+
+async def ec_decode(env: CommandEnv, collection: str = "",
+                    vids: list[int] | None = None) -> list[dict]:
+    """ec.decode command entry (command_ec_decode.go:37-69)."""
+    shard_map = await ec_shard_map(env)
+    results = []
+    for vid, info in sorted(shard_map.items()):
+        if collection and info["collection"] != collection:
+            continue
+        if vids and vid not in vids:
+            continue
+        try:
+            results.append(await ec_decode_volume(env, vid, info))
+        except RuntimeError as e:
+            # one volume failing (e.g. 409 missing shard) must not
+            # abort the rest of the batch (ec_rebuild reports the same
+            # way)
+            results.append({"volume": vid, "error": str(e)})
     return results
 
 

@@ -16,6 +16,7 @@ from .env import CommandEnv
 HELP = """commands:
   ec.encode    [-collection c] [-volumeId n] [-fullPercent 95]
   ec.rebuild   [-collection c] [-force]
+  ec.decode    [-collection c] [-volumeId n]
   ec.balance   [-collection c] [-force]
   volume.vacuum          [-garbageThreshold 0.3] [-collection c]
   volume.fix.replication [-force]
@@ -69,6 +70,10 @@ async def run_command(master_url: str, line: str) -> object:
             res = await ec.ec_rebuild(
                 env, collection=flags.get("collection", ""),
                 apply_changes=flags.get("force") == "true")
+        elif cmd == "ec.decode":
+            vids = [int(flags["volumeId"])] if "volumeId" in flags else None
+            res = await ec.ec_decode(
+                env, collection=flags.get("collection", ""), vids=vids)
         elif cmd == "ec.balance":
             res = await ec.ec_balance(
                 env, collection=flags.get("collection", ""),

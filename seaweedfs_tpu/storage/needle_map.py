@@ -35,8 +35,12 @@ def pack_entry(key: int, actual_offset: int, size: int) -> bytes:
             f"use set_offset_size(5) / SWTPU_OFFSET_BYTES=5")
     if t.OFFSET_SIZE == 4:
         return _ENTRY4.pack(key, units, size)
+    # reference 5BytesOffset layout (offset_5bytes.go:18-24): the LOW 32
+    # bits big-endian in bytes[0..3], the high byte LAST in bytes[4] —
+    # NOT a plain 5-byte big-endian integer
     return (key.to_bytes(t.NEEDLE_ID_SIZE, "big")
-            + units.to_bytes(t.OFFSET_SIZE, "big")
+            + (units & 0xFFFFFFFF).to_bytes(4, "big")
+            + bytes([units >> 32])
             + size.to_bytes(t.SIZE_SIZE, "big"))
 
 
@@ -47,7 +51,8 @@ def unpack_entry(blob: bytes, pos: int = 0) -> tuple[int, int, int]:
     else:
         key = int.from_bytes(blob[pos:pos + t.NEEDLE_ID_SIZE], "big")
         p = pos + t.NEEDLE_ID_SIZE
-        units = int.from_bytes(blob[p:p + t.OFFSET_SIZE], "big")
+        units = (int.from_bytes(blob[p:p + 4], "big")
+                 | (blob[p + 4] << 32))
         p += t.OFFSET_SIZE
         size = int.from_bytes(blob[p:p + t.SIZE_SIZE], "big")
     return key, units * t.NEEDLE_PADDING_SIZE, size

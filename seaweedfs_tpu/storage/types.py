@@ -63,19 +63,27 @@ def random_cookie() -> int:
 
 def offset_to_bytes(actual_offset: int) -> bytes:
     """actual byte offset -> stored offset (units of 8 bytes, current
-    width). Raises instead of silently wrapping past the volume cap."""
+    width). Raises instead of silently wrapping past the volume cap.
+
+    5-byte width follows the reference layout (offset_5bytes.go:18-24):
+    low 32 bits big-endian, then the high byte LAST."""
     assert actual_offset % NEEDLE_PADDING_SIZE == 0, actual_offset
     units = actual_offset // NEEDLE_PADDING_SIZE
     if units >= 1 << (8 * OFFSET_SIZE):
         raise OverflowError(
             f"offset {actual_offset} exceeds the {OFFSET_SIZE}-byte index "
             f"limit ({max_volume_size()} bytes); use set_offset_size(5)")
-    return units.to_bytes(OFFSET_SIZE, "big")
+    if OFFSET_SIZE == 4:
+        return units.to_bytes(4, "big")
+    return (units & 0xFFFFFFFF).to_bytes(4, "big") + bytes([units >> 32])
 
 
 def offset_from_bytes(b: bytes) -> int:
     """Stored offset (current width) -> actual byte offset."""
-    return int.from_bytes(b[:OFFSET_SIZE], "big") * NEEDLE_PADDING_SIZE
+    units = int.from_bytes(b[:4], "big")
+    if OFFSET_SIZE == 5:
+        units |= b[4] << 32
+    return units * NEEDLE_PADDING_SIZE
 
 
 def padding_length(needle_size: int, version: int) -> int:

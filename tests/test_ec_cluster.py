@@ -132,3 +132,70 @@ def test_ec_rebuild_unrepairable_reported(tmp_path):
             assert any("unrepairable" in str(r.get("error", ""))
                        for r in results), results
     run(body())
+
+
+def test_ec_decode_back_to_normal_volume(tmp_path):
+    """The un-EC path (command_ec_decode.go + VolumeEcShardsToVolume):
+    encode -> delete original -> lose a data shard -> ec.decode -> every
+    needle reads back from the reassembled NORMAL volume."""
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=4) as c:
+            files = await _fill_volume(c, n_files=25)
+            await c.heartbeat_all()
+            vids = sorted({int(f.split(",")[0]) for f, _, _ in files})
+            async with CommandEnv(c.master.url, c.http) as env:
+                await ec.ec_encode(env, collection="ectest", vids=vids)
+            await c.heartbeat_all()
+            vid = vids[0]
+            # original volume is gone everywhere (sealed into shards)
+            for vs in c.servers:
+                assert vid not in vs.store.volumes
+
+            # delete one needle through the EC path so the decode must
+            # carry the tombstone into the rebuilt .idx
+            del_fid, del_url, _ = files[-1]
+            assert await c.delete(del_fid, del_url) == 200
+
+            # lose one server's shards entirely: decode must gather +
+            # reconstruct before reassembly
+            import seaweedfs_tpu.ec.pipeline as pl
+            async with CommandEnv(c.master.url, c.http) as env:
+                smap = await ec.ec_shard_map(env)
+            victim_url = smap[vid]["shards"][0][0]
+            victim = next(v for v in c.servers if v.url == victim_url)
+            lost = sorted(victim.store.ec_volumes[vid].shards)
+            base = victim._base_name(vid, "ectest")
+            victim.store.unmount_ec_shards(vid)
+            for sid in lost:
+                os.remove(base + pl.to_ext(sid))
+            await c.heartbeat_all()
+
+            async with CommandEnv(c.master.url, c.http) as env:
+                results = await ec.ec_decode(env, collection="ectest",
+                                             vids=[vid])
+            assert results and "error" not in results[0], results
+            target_url = results[0]["node"]
+            await c.heartbeat_all()
+
+            # the volume is back as a NORMAL volume on the target and the
+            # EC shards are gone cluster-wide
+            target = next(v for v in c.servers if v.url == target_url)
+            assert vid in target.store.volumes
+            for vs in c.servers:
+                assert vid not in vs.store.ec_volumes
+                b = vs._base_name(vid, "ectest")
+                if b:
+                    assert not any(
+                        os.path.exists(b + pl.to_ext(s))
+                        for s in range(14)), vs.url
+
+            # every live needle reads back through the normal read path
+            for fid, url, data in files[:-1]:
+                if int(fid.split(",")[0]) != vid:
+                    continue
+                st, got = await c.get(fid, target.url)
+                assert st == 200 and got == data, fid
+            # the EC-deleted needle stays deleted in the rebuilt volume
+            st, _ = await c.get(del_fid, target.url)
+            assert st == 404
+    run(body())

@@ -36,6 +36,19 @@ def test_4byte_offset_overflow_raises():
     assert t.offset_from_bytes(t.offset_to_bytes(top)) == top
 
 
+def test_5byte_wire_layout_matches_reference(five_byte):
+    """offset_5bytes.go:18-24 stores the LOW 32 bits big-endian in
+    bytes[0..3] and the HIGH byte LAST — pin the exact wire bytes so a
+    reference-written 5-byte index parses identically."""
+    units = (0x07 << 32) | 0x0A0B0C0D
+    blob = pack_entry(0x11, units * 8, 5)
+    # key(8) + low32-BE + high byte + size(4)
+    assert blob[8:13] == bytes([0x0A, 0x0B, 0x0C, 0x0D, 0x07])
+    assert t.offset_to_bytes(units * 8) == bytes(
+        [0x0A, 0x0B, 0x0C, 0x0D, 0x07])
+    assert t.offset_from_bytes(blob[8:13]) == units * 8
+
+
 def test_5byte_entry_roundtrip_past_32gb(five_byte):
     """Synthetic >32 GiB offsets round-trip through the 17-byte entry
     (offset_5bytes.go:14-16: 8 TB volumes)."""
