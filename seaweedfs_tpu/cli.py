@@ -156,6 +156,9 @@ def build_parser() -> argparse.ArgumentParser:
     up.add_argument("-collection", default="")
     up.add_argument("-replication", default="")
     up.add_argument("-ttl", default="")
+    up.add_argument("-maxMB", type=int, default=0,
+                    help="split files larger than this into a chunk "
+                         "manifest (0 = never split)")
 
     dl = sub.add_parser("download", help="download a fid")
     _add_common(dl)
@@ -482,11 +485,24 @@ async def _run_server(args) -> None:
 
 async def _run_upload(args) -> None:
     from .util.client import WeedClient
+    max_mb = getattr(args, "maxMB", 0) or 0
     async with WeedClient(args.master) as c:
         out = []
         for path in args.files:
             with open(path, "rb") as f:
                 data = f.read()
+            if max_mb > 0 and len(data) > max_mb * 1024 * 1024:
+                # auto-split into a chunk manifest (submit.go:112-199)
+                from .util.chunked import upload_in_chunks
+                fid, cm = await upload_in_chunks(
+                    c, data, max_mb, name=os.path.basename(path),
+                    collection=args.collection,
+                    replication=args.replication, ttl=args.ttl)
+                out.append({"fileName": os.path.basename(path),
+                            "fid": fid, "size": len(data),
+                            "chunks": len(cm.chunks),
+                            "fileUrl": await c.lookup_file_id(fid)})
+                continue
             fid = await c.upload_data(data, collection=args.collection,
                                       replication=args.replication,
                                       ttl=args.ttl)

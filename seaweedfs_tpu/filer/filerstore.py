@@ -75,7 +75,8 @@ def _load_builtin() -> None:
     # driver-gated plugins (reference: mysql/postgres via abstract_sql —
     # registered inside abstract_sql_store when drivers import — plus
     # cassandra/redis/etcd below)
-    for mod in ("redis_store", "etcd_store", "cassandra_store"):
+    for mod in ("redis_store", "etcd_store", "cassandra_store",
+                "tikv_store"):
         try:
             __import__(f"seaweedfs_tpu.filer.stores.{mod}")
         except ImportError:

@@ -56,7 +56,13 @@ class EtcdStore(FilerStore):
 
     def delete_folder_children(self, path: str) -> None:
         p = path.rstrip("/") or "/"
+        # direct children live under `p \x00`; every deeper descendant's
+        # key starts with `p /` (its dir path extends p) — both ranges
+        # must go or grandchildren are orphaned. For the root, "p/"
+        # collapses to "/" (not "//", which matches nothing).
         self._c.delete_prefix(f"{self.prefix}{p}{SEP}")
+        self._c.delete_prefix(
+            self.prefix + (p if p != "/" else "") + "/")
 
     def list_directory_entries(self, dir_path: str, start_file: str,
                                inclusive: bool, limit: int) -> list[Entry]:

@@ -144,28 +144,13 @@ class SqliteQueue(MessageQueue):
         self._db.close()
 
 
-class _GatedQueue(MessageQueue):
-    """Placeholder for brokers whose client library isn't in the image
-    (kafka via sarama, AWS SQS, GCP Pub/Sub, GoCDK in the reference)."""
-
-    def __init__(self, name: str, pip_hint: str):
-        self.name = name
-        self._hint = pip_hint
-
-    def initialize(self, config: dict) -> None:
-        raise RuntimeError(
-            f"notification queue {self.name!r} requires {self._hint}, "
-            f"which is not available in this environment")
-
-    def send_message(self, key: str, event: dict) -> None:
-        raise RuntimeError(f"queue {self.name!r} not initialized")
+def _broker_queues() -> "list[MessageQueue]":
+    from .brokers import GooglePubSubQueue, KafkaQueue, SqsQueue
+    return [KafkaQueue(), SqsQueue(), GooglePubSubQueue()]
 
 
 MESSAGE_QUEUES: list[MessageQueue] = [
-    LogQueue(), FileQueue(), SqliteQueue(),
-    _GatedQueue("kafka", "a kafka client"),
-    _GatedQueue("aws_sqs", "boto3"),
-    _GatedQueue("google_pub_sub", "google-cloud-pubsub"),
+    LogQueue(), FileQueue(), SqliteQueue(), *_broker_queues(),
 ]
 
 

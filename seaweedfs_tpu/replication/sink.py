@@ -271,27 +271,16 @@ class LocalDirSink(ReplicationSink):
             os.unlink(p)
 
 
-class _GatedSink(ReplicationSink):
-    """gcssink/azuresink/b2sink equivalents need cloud SDKs not present
-    in this image."""
-
-    def __init__(self, name: str, pip_hint: str):
-        super().__init__()
-        self.name = name
-        self._hint = pip_hint
-
-    async def start(self) -> None:
-        raise RuntimeError(
-            f"replication sink {self.name!r} requires {self._hint}, "
-            f"which is not available in this environment")
+def _sinks() -> dict:
+    from .cloud_sinks import AzureSink, B2Sink, GcsSink
+    return {
+        "filer": FilerSink,
+        "s3": S3Sink,
+        "local": LocalDirSink,
+        "google_cloud_storage": GcsSink,
+        "azure": AzureSink,
+        "backblaze": B2Sink,
+    }
 
 
-SINKS: dict[str, type | object] = {
-    "filer": FilerSink,
-    "s3": S3Sink,
-    "local": LocalDirSink,
-    "google_cloud_storage": _GatedSink("google_cloud_storage",
-                                       "google-cloud-storage"),
-    "azure": _GatedSink("azure", "azure-storage-blob"),
-    "backblaze": _GatedSink("backblaze", "b2sdk"),
-}
+SINKS: dict[str, type] = _sinks()

@@ -23,7 +23,8 @@ from ..ec import pipeline as ecpl
 from ..pb import messages as pb
 from ..util import glog
 from ..storage import types as t
-from ..storage.needle import FLAG_GZIP, FLAG_HAS_LAST_MODIFIED, CrcMismatch, Needle
+from ..storage.needle import (FLAG_GZIP, FLAG_HAS_LAST_MODIFIED,
+                              FLAG_IS_CHUNK_MANIFEST, CrcMismatch, Needle)
 from ..storage.store import Store
 from ..storage.volume import AlreadyDeleted, NotFound, VolumeError
 from ..security import tls
@@ -265,6 +266,10 @@ class VolumeServer:
             return web.json_response({"error": str(e)}, status=500)
         headers = {"Etag": f'"{n.etag()}"', "Accept-Ranges": "bytes"}
         body = n.data
+        if n.is_chunked_manifest and req.query.get("cm") != "false":
+            # resolve the manifest into the assembled file
+            # (tryHandleChunkedFile, volume_server_handlers_read.go:170)
+            return await self._serve_chunked_file(req, n)
         if n.is_gzipped:
             if "gzip" in req.headers.get("Accept-Encoding", ""):
                 headers["Content-Encoding"] = "gzip"
@@ -314,6 +319,64 @@ class VolumeServer:
         return web.Response(body=body, headers=headers, content_type=ct,
                             status=status)
 
+    def _weed_client(self):
+        """Lazily-built client for chunk fetches (lookup-cached)."""
+        if getattr(self, "_wclient", None) is None:
+            from ..util.client import WeedClient
+            self._wclient = WeedClient(self.master_url,
+                                       session=self._http,
+                                       jwt_key=self.jwt_key)
+        # track master failover: the heartbeat loop reassigns
+        # self.master_url when the leader changes
+        self._wclient.master_url = self.master_url
+        return self._wclient
+
+    async def _serve_chunked_file(self, req: web.Request,
+                                  n: Needle) -> web.StreamResponse:
+        """tryHandleChunkedFile (volume_server_handlers_read.go:170-199):
+        the needle body is a ChunkManifest; stream the assembled bytes,
+        honoring Range so large files never fully buffer."""
+        from ..util.chunked import ChunkManifest
+        from ..util.client import OperationError
+        from ..util.httprange import RangeError, parse_range
+        try:
+            cm = ChunkManifest.load(n.data, n.is_gzipped)
+        except (ValueError, KeyError) as e:
+            return web.json_response(
+                {"error": f"bad chunk manifest: {e}"}, status=500)
+        headers = {"Accept-Ranges": "bytes", "Etag": f'"{n.etag()}"'}
+        ct = cm.mime or (n.mime.decode() if n.mime
+                         else "application/octet-stream")
+        if cm.name:
+            headers["Content-Disposition"] = \
+                f'inline; filename="{cm.name}"'
+        try:
+            rng = parse_range(req.headers.get("Range", ""), cm.size)
+        except RangeError:
+            return web.Response(
+                status=416,
+                headers={"Content-Range": f"bytes */{cm.size}"})
+        off, ln = rng if rng is not None else (0, cm.size)
+        status = 206 if rng is not None else 200
+        if rng is not None:
+            headers["Content-Range"] = f"bytes {off}-{off+ln-1}/{cm.size}"
+        headers["Content-Length"] = str(ln)
+        if req.method == "HEAD":
+            return web.Response(status=status, headers=headers,
+                                content_type=ct)
+        resp = web.StreamResponse(status=status, headers=headers)
+        resp.content_type = ct
+        await resp.prepare(req)
+        client = self._weed_client()
+        for fid, c_off, c_len, _ in cm.resolve(off, ln):
+            try:
+                piece = await client.read(fid, offset=c_off, size=c_len)
+            except OperationError:
+                break  # stream truncates; client sees short body
+            await resp.write(piece)
+        await resp.write_eof()
+        return resp
+
     async def _needle_from_request(self, req: web.Request,
                                    fid: t.FileId) -> Needle:
         """ParseUpload analog (needle.go:54): multipart or raw body."""
@@ -345,6 +408,9 @@ class VolumeServer:
                    mime=mime, ttl=t.TTL.parse(req.query.get("ttl", "")),
                    last_modified=int(time.time()))
         n.set_flag(FLAG_HAS_LAST_MODIFIED)
+        if req.query.get("cm") in ("true", "1"):
+            # chunk-manifest needle (needle_parse_multipart.go:86)
+            n.set_flag(FLAG_IS_CHUNK_MANIFEST)
         return n
 
     def _check_jwt(self, req: web.Request) -> web.Response | None:
@@ -417,8 +483,24 @@ class VolumeServer:
             return web.json_response({"error": str(e)}, status=400)
         n = Needle(cookie=fid.cookie, id=fid.key)
         is_ec = fid.volume_id in self.store.ec_volumes
+        # a chunk-manifest delete cascades to its chunks — also through
+        # the EC read path, or a manifest in an EC-encoded volume would
+        # orphan every chunk (volume_server_handlers_write.go
+        # DeleteHandler)
+        loop = asyncio.get_running_loop()
+        if req.query.get("type") != "replicate":
+            try:
+                existing = await loop.run_in_executor(
+                    None, lambda: self.store.read_needle(
+                        fid.volume_id, fid.key, fid.cookie))
+                if existing.is_chunked_manifest:
+                    from ..util.chunked import ChunkManifest
+                    cm = ChunkManifest.load(existing.data,
+                                            existing.is_gzipped)
+                    await cm.delete_chunks(self._weed_client())
+            except (NotFound, AlreadyDeleted, ValueError, KeyError):
+                pass
         try:
-            loop = asyncio.get_running_loop()
             size = await loop.run_in_executor(
                 None, lambda: self.store.delete_needle(fid.volume_id, n))
         except NotFound:

@@ -130,6 +130,25 @@ class WeedClient:
                 raise OperationError(f"upload {fid}: {body}")
             return body
 
+    async def upload_manifest(self, fid: str, url: str, manifest,
+                              ttl: str = "", auth: str = "") -> dict:
+        """Store a ChunkManifest needle (?cm=true marks the flag;
+        operation/submit.go:222, needle_parse_multipart.go:86)."""
+        params = {"cm": "true"}
+        if ttl:
+            params["ttl"] = ttl
+        headers = {"Content-Type": "application/json"}
+        token = auth or self._mint_jwt(fid)
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
+        async with self.http.post(tls.url(url, f"/{fid}"),
+                                  data=manifest.marshal(),
+                                  params=params, headers=headers) as resp:
+            body = await resp.json()
+            if resp.status not in (200, 201):
+                raise OperationError(f"upload manifest {fid}: {body}")
+            return body
+
     async def upload_data(self, data: bytes, collection: str = "",
                           replication: str = "", ttl: str = "",
                           mime: str = "") -> str:
