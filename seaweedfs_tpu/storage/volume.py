@@ -187,12 +187,16 @@ class Volume:
     # ---- I/O core ----
 
     def data_size(self) -> int:
-        self._dat.seek(0, os.SEEK_END)
-        return self._dat.tell()
+        # fstat, NOT seek(END): this is called lock-free from the
+        # heartbeat/stats paths, and moving the shared fd's position
+        # would race a locked reader between its seek and read
+        return os.fstat(self._dat.fileno()).st_size
 
     def _read_at(self, offset: int, size: int) -> Needle:
-        self._dat.seek(offset)
-        blob = self._dat.read(t.actual_size(size, self.version))
+        # positioned read: no shared seek state with writers or other
+        # readers (the reference uses ReadAt for the same reason)
+        blob = os.pread(self._dat.fileno(),
+                        t.actual_size(size, self.version), offset)
         return Needle.from_bytes(blob, self.version)
 
     def write_needle(self, n: Needle) -> tuple[int, int]:
@@ -266,16 +270,15 @@ class Volume:
     def scan(self, visit) -> None:
         """visit(needle, offset) over every record incl. tombstones."""
         size = self.data_size()
+        fd = self._dat.fileno()
         offset = 8  # past the superblock
         while offset + t.NEEDLE_HEADER_SIZE <= size:
-            self._dat.seek(offset)
-            header = self._dat.read(t.NEEDLE_HEADER_SIZE)
+            header = os.pread(fd, t.NEEDLE_HEADER_SIZE, offset)
             if len(header) < t.NEEDLE_HEADER_SIZE:
                 break
             body_size = int.from_bytes(header[12:16], "big")
             rec_len = t.actual_size(body_size, self.version)
-            self._dat.seek(offset)
-            blob = self._dat.read(rec_len)
+            blob = os.pread(fd, rec_len, offset)
             if len(blob) < rec_len:
                 break
             n = Needle.from_bytes(blob, self.version, check_crc=False)
