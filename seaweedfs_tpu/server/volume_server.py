@@ -25,6 +25,7 @@ from ..util import glog
 from ..storage import types as t
 from ..storage.needle import (FLAG_GZIP, FLAG_HAS_LAST_MODIFIED,
                               FLAG_IS_CHUNK_MANIFEST, CrcMismatch, Needle)
+from ..storage.backend import BackendError
 from ..storage.store import Store
 from ..storage.volume import AlreadyDeleted, NotFound, VolumeError
 from ..security import tls
@@ -264,6 +265,12 @@ class VolumeServer:
             return web.Response(status=404)
         except CrcMismatch as e:
             return web.json_response({"error": str(e)}, status=500)
+        except BackendError as e:
+            # tiered volume whose remote tier is unreachable: surface a
+            # clean 503 instead of an unhandled traceback
+            if metrics.HAVE_PROMETHEUS:
+                metrics.VOLUME_REQUEST_COUNTER.labels("read", "error").inc()
+            return web.json_response({"error": str(e)}, status=503)
         headers = {"Etag": f'"{n.etag()}"', "Accept-Ranges": "bytes"}
         body = n.data
         if n.is_chunked_manifest and req.query.get("cm") != "false":
@@ -498,7 +505,9 @@ class VolumeServer:
                     cm = ChunkManifest.load(existing.data,
                                             existing.is_gzipped)
                     await cm.delete_chunks(self._weed_client())
-            except (NotFound, AlreadyDeleted, ValueError, KeyError):
+            except (NotFound, AlreadyDeleted, ValueError, KeyError,
+                    BackendError):
+                # tier outage: skip the manifest check, still tombstone
                 pass
         try:
             size = await loop.run_in_executor(
@@ -1076,7 +1085,7 @@ class VolumeServer:
                 recs = await loop.run_in_executor(
                     None, lambda: read_and_query(fid))
             except (ValueError, NotFound, AlreadyDeleted, VolumeError,
-                    CrcMismatch, gzip.BadGzipFile, OSError):
+                    CrcMismatch, gzip.BadGzipFile, OSError, BackendError):
                 continue
             for rec in recs:
                 await resp.write(_json.dumps(rec).encode() + b"\n")

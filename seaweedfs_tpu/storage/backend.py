@@ -255,6 +255,13 @@ class RemoteDatFile:
         self._pos += len(data)
         return data
 
+    def pread(self, size: int, offset: int) -> bytes:
+        # positioned read (os.pread argument order) — no shared seek state
+        return self._bf.read_at(offset, size)
+
+    def size(self) -> int:
+        return self._bf.size()
+
     def write(self, data: bytes) -> int:
         raise BackendError("tiered volume is read-only")
 

@@ -40,6 +40,28 @@ class VolumeStat:
     read_only: bool
 
 
+class _ReadaheadCursor:
+    """Window buffer over a positioned-read callable for sequential scans:
+    each miss fetches one large chunk, so a remote .dat walk costs
+    O(size/chunk) ranged GETs instead of two per record."""
+
+    def __init__(self, pread, size: int, chunk: int = 4 << 20):
+        self._pread = pread
+        self._size = size
+        self._chunk = chunk
+        self._start = 0
+        self._buf = b""
+
+    def read(self, nbytes: int, offset: int) -> bytes:
+        end = offset + nbytes
+        if offset < self._start or end > self._start + len(self._buf):
+            want = max(nbytes, self._chunk)
+            self._buf = self._pread(min(want, self._size - offset), offset)
+            self._start = offset
+        lo = offset - self._start
+        return self._buf[lo:lo + nbytes]
+
+
 class Volume:
     """One volume on local disk: <dir>/<collection_><vid>.dat / .idx."""
 
@@ -90,7 +112,9 @@ class Volume:
                             0 if last[2] == t.TOMBSTONE_FILE_SIZE
                             else last[2])
                         self.last_append_at_ns = n.append_at_ns
-                    except NeedleError:
+                    except (NeedleError, _backend.BackendError):
+                        # a transient tier outage must not abort the load;
+                        # the watermark is best-effort on tiered volumes
                         pass
                 return
         if not exists and not create_if_missing:
@@ -189,14 +213,26 @@ class Volume:
     def data_size(self) -> int:
         # fstat, NOT seek(END): this is called lock-free from the
         # heartbeat/stats paths, and moving the shared fd's position
-        # would race a locked reader between its seek and read
-        return os.fstat(self._dat.fileno()).st_size
+        # would race a locked reader between its seek and read.
+        # A tiered volume's _dat is a RemoteDatFile (no fileno); its
+        # size() is a backend HEAD, equally position-free.
+        fileno = getattr(self._dat, "fileno", None)
+        if fileno is None:
+            return self._dat.size()
+        return os.fstat(fileno()).st_size
+
+    def _pread(self, nbytes: int, offset: int) -> bytes:
+        # positioned read: no shared seek state with writers or other
+        # readers (the reference uses ReadAt for the same reason).
+        # Tiered volumes route through RemoteDatFile.pread -> ranged GET
+        # (s3_backend.go:113-146).
+        fileno = getattr(self._dat, "fileno", None)
+        if fileno is None:
+            return self._dat.pread(nbytes, offset)
+        return os.pread(fileno(), nbytes, offset)
 
     def _read_at(self, offset: int, size: int) -> Needle:
-        # positioned read: no shared seek state with writers or other
-        # readers (the reference uses ReadAt for the same reason)
-        blob = os.pread(self._dat.fileno(),
-                        t.actual_size(size, self.version), offset)
+        blob = self._pread(t.actual_size(size, self.version), offset)
         return Needle.from_bytes(blob, self.version)
 
     def write_needle(self, n: Needle) -> tuple[int, int]:
@@ -270,15 +306,19 @@ class Volume:
     def scan(self, visit) -> None:
         """visit(needle, offset) over every record incl. tombstones."""
         size = self.data_size()
-        fd = self._dat.fileno()
         offset = 8  # past the superblock
+        # sequential walk: on a tiered volume, coalesce the per-record
+        # preads into few large ranged GETs instead of 2 round trips
+        # per needle
+        pread = (_ReadaheadCursor(self._pread, size).read
+                 if self.is_remote else self._pread)
         while offset + t.NEEDLE_HEADER_SIZE <= size:
-            header = os.pread(fd, t.NEEDLE_HEADER_SIZE, offset)
+            header = pread(t.NEEDLE_HEADER_SIZE, offset)
             if len(header) < t.NEEDLE_HEADER_SIZE:
                 break
             body_size = int.from_bytes(header[12:16], "big")
             rec_len = t.actual_size(body_size, self.version)
-            blob = os.pread(fd, rec_len, offset)
+            blob = pread(rec_len, offset)
             if len(blob) < rec_len:
                 break
             n = Needle.from_bytes(blob, self.version, check_crc=False)
