@@ -76,6 +76,58 @@ def bench_cpu(n_bytes_per_shard: int = 4 << 20) -> tuple[float, str]:
     return gf.DATA_SHARDS * n_bytes_per_shard / dt / 1e9, kind
 
 
+def bench_degraded_read(n_needles: int = 64, payload: int = 8 << 10,
+                        reads: int = 300) -> dict:
+    """p50/p99 latency of EcVolume.read_needle with one data shard file
+    deleted — every read reconstructs its intervals from the 13 survivors
+    (the BASELINE.json config-5 path; store_ec.go:319-373 analog).
+    Host-path measurement: small recover intervals route to the CPU
+    encoder (EcVolume.SMALL_RECOVER_BYTES), so no device in the loop."""
+    import shutil
+    import tempfile
+
+    from seaweedfs_tpu.ec import pipeline as ecpl
+    from seaweedfs_tpu.ec.ec_volume import EcVolume
+    from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    # CPU encoder throughout: this is a latency benchmark of the storage
+    # path, and the parent must never init the device backend (that is
+    # the killable child's job)
+    enc = CpuEncoder()
+    tmp = tempfile.mkdtemp(prefix="swtpu_bench_ec_")
+    try:
+        v = Volume(tmp, "", 1)
+        rng = np.random.default_rng(11)
+        for i in range(1, n_needles + 1):
+            v.write_needle(Needle(cookie=0x1234, id=i,
+                                  data=rng.integers(0, 256, payload)
+                                  .astype(np.uint8).tobytes()))
+        v.close()
+        base = os.path.join(tmp, "1")
+        ecpl.write_ec_files(base, encoder=enc)
+        ecpl.write_sorted_file_from_idx(base)
+        os.remove(base + ".ec00")  # lose a data shard
+        ev = EcVolume(tmp, "", 1, encoder=enc)
+        lat = []
+        for r in range(reads):
+            nid = (r % n_needles) + 1
+            t0 = time.perf_counter()
+            n = ev.read_needle(nid)
+            lat.append((time.perf_counter() - t0) * 1e3)
+            assert len(n.data) == payload
+        ev.close()
+        lat.sort()
+        return {
+            "degraded_read_p50_ms": round(lat[len(lat) // 2], 3),
+            "degraded_read_p99_ms": round(lat[int(len(lat) * 0.99)], 3),
+            "degraded_read_reads": reads,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # ---------------------------------------------------------------------------
 # Child: all device work. Streams cumulative JSON results line-by-line.
 # ---------------------------------------------------------------------------
@@ -152,33 +204,71 @@ def _roundtrip_latency() -> float:
     return (time.perf_counter() - t0) / iters
 
 
+# Physical ceiling for PLAUSIBLE results: v5e HBM bandwidth is ~819 GB/s
+# and every kernel step must at least read k*n from HBM, so any measured
+# data-GB/s above this is a harness artifact, never a real number. The
+# round-3 bench published 83,886,080 "GB/s" because a clamp turned short
+# timings into exactly bytes/ns — this bound rejects that entire failure
+# class instead of reporting it.
+HBM_BOUND_GBPS = 819.0
+
+
+class ImplausibleResult(Exception):
+    pass
+
+
 def _chained_gbs(transform, consts, words, n: int, chain_len: int,
-                 rtt: float) -> float:
+                 rtt: float) -> tuple[float, float, int]:
     """Sustained GB/s of data-shard bytes through the kernel, amortising
     dispatch latency over chain_len dependent kernel invocations inside
-    one jit (outputs feed the next step's inputs, preventing CSE)."""
+    one jit (outputs feed the next step's inputs, preventing CSE).
+
+    Measurement honesty rules (the round-3 verdict's #1):
+      * rtt is subtracted ONLY when the timed chain dwarfs it (dt > 10*rtt)
+        — never clamped; a chain too short to measure is GROWN, not faked.
+      * any result above the HBM ceiling raises ImplausibleResult.
+    Returns (gbs, dt, chain_len actually used).
+    """
     import jax
     import jax.numpy as jnp
 
     k = len(words)
     rows = consts.shape[0]
 
-    @jax.jit
-    def chain(*w):
-        ws = list(w)
-        for _ in range(chain_len):
-            outs = list(transform(consts, ws))
-            ws = (outs + ws)[:k]
-        return sum(jnp.sum(x, dtype=jnp.uint32) for x in ws[:rows])
+    def build(cl):
+        @jax.jit
+        def chain(*w):
+            ws = list(w)
+            for _ in range(cl):
+                outs = list(transform(consts, ws))
+                ws = (outs + ws)[:k]
+            return sum(jnp.sum(x, dtype=jnp.uint32) for x in ws[:rows])
+        return chain
 
-    float(chain(*words))  # compile
-    iters = 2
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        float(chain(*words))
-    dt = (time.perf_counter() - t0) / iters
-    per_step = max(dt - rtt, 1e-9) / chain_len
-    return k * n / per_step / 1e9
+    for _attempt in range(4):
+        chain = build(chain_len)
+        float(chain(*words))  # compile
+        iters = 2
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            float(chain(*words))
+        dt = (time.perf_counter() - t0) / iters
+        if dt > 10 * rtt or chain_len >= 256:
+            break
+        # chain too short to separate from dispatch latency: grow it so
+        # kernel time dominates instead of subtracting into the noise
+        grow = max(2, int(10 * rtt / max(dt, 1e-6)) + 1)
+        chain_len = min(256, chain_len * grow)
+        _log(f"  chain too short (dt={dt * 1e3:.0f}ms vs rtt="
+             f"{rtt * 1e3:.0f}ms); growing chain to {chain_len}")
+    per_step = ((dt - rtt) if dt > 10 * rtt else dt) / chain_len
+    gbs = k * n / per_step / 1e9
+    if gbs > HBM_BOUND_GBPS:
+        raise ImplausibleResult(
+            f"{gbs:.0f} GB/s exceeds the {HBM_BOUND_GBPS:.0f} GB/s HBM "
+            f"ceiling (dt={dt * 1e3:.1f}ms chain={chain_len}) — "
+            f"measurement artifact, not reported")
+    return gbs, dt, chain_len
 
 
 def child_main() -> None:
@@ -227,41 +317,23 @@ def child_main() -> None:
     max_bytes = int(os.environ.get(
         "SWTPU_BENCH_BYTES", str((64 << 20) if backend == "tpu"
                                  else (1 << 20))))
+    # chains sized so the timed region dwarfs the ~70ms dispatch rtt even
+    # at ~100 GB/s (the adaptive growth in _chained_gbs backstops this)
     stages = [(s, c) for s, c in [
-        (1 << 20, 1), (4 << 20, 2), (16 << 20, 4), (64 << 20, 8),
-        (256 << 20, 8)] if s <= max_bytes]
+        (4 << 20, 64), (16 << 20, 32), (64 << 20, 32),
+        (256 << 20, 16)] if s <= max_bytes]
     if not stages:  # tiny SWTPU_BENCH_BYTES: still measure one stage
         stages = [(max(128 << 10, (max_bytes // (128 << 10)) * (128 << 10)),
-                   1)]
+                   2)]
     detail: dict = {"dispatch_rtt_ms": round(rtt * 1e3, 1)}
 
     k = gf.DATA_SHARDS
-    for n, chain_len in stages:
-        if left() < 30:
-            _log(f"budget exhausted before stage n={n >> 20}MB — stopping")
-            break
-        # generate stripes ON DEVICE: device_put of NxGB through the axon
-        # tunnel takes minutes, PRNG keys are a few bytes
-        make = jax.jit(
-            lambda key: jax.random.bits(key, (n // 512, 128), jnp.uint32))
-        keys = jax.random.split(jax.random.PRNGKey(0), k)
-        words = [make(keys[i]) for i in range(k)]
-        jax.block_until_ready(words)
-        for name in good:
-            for op, coeff in (("encode", enc_coeff), ("rebuild4", reb_coeff)):
-                if left() < 15:
-                    break
-                try:
-                    gbs = _chained_gbs(paths[name], coeff, words, n,
-                                       chain_len, rtt)
-                except Exception as e:  # noqa: BLE001
-                    detail[f"{op}_{name}_error"] = str(e)[:200]
-                    _log(f"{op}/{name} n={n >> 20}MB FAILED: {e}")
-                    continue
-                key = f"{op}_{name}"
-                detail[key] = max(detail.get(key, 0.0), round(gbs, 2))
-                _log(f"{op}/{name} n={n >> 20}MB chain={chain_len}: "
-                     f"{gbs:.2f} GB/s")
+    speeds: dict[str, float] = {}  # path -> best measured GB/s so far
+
+    def emit_cumulative(n: int) -> None:
+        """Stream the best-so-far result after EVERY measurement, so a
+        budget kill can never lose numbers that were already measured
+        (the round-3 16MB results died exactly that way)."""
         enc = max((v for d, v in detail.items()
                    if d.startswith("encode_") and isinstance(v, float)),
                   default=0.0)
@@ -275,28 +347,80 @@ def child_main() -> None:
             stage_res["value"] = min(enc, reb)
         _emit(stage_res)
 
+    for n, chain_len in stages:
+        if left() < 30:
+            _log(f"budget exhausted before stage n={n >> 20}MB — stopping")
+            break
+        # generate stripes ON DEVICE: device_put of NxGB through the axon
+        # tunnel takes minutes, PRNG keys are a few bytes
+        make = jax.jit(
+            lambda key: jax.random.bits(key, (n // 512, 128), jnp.uint32))
+        keys = jax.random.split(jax.random.PRNGKey(0), k)
+        words = [make(keys[i]) for i in range(k)]
+        jax.block_until_ready(words)
+        best = max(speeds.values(), default=0.0)
+        for name in sorted(good, key=lambda p: -speeds.get(p, 1e9)):
+            if speeds.get(name, 1e9) < best / 5:
+                # this path lost the race decisively at a smaller stage;
+                # spend the remaining budget on the winner's curve
+                _log(f"skipping {name} at {n >> 20}MB (lost race: "
+                     f"{speeds[name]:.1f} vs {best:.1f} GB/s)")
+                continue
+            cl = chain_len
+            if name in speeds:
+                # size the chain from the measured speed so the timed
+                # region lands near max(0.7s, 12*rtt) on the first try
+                per_step = k * n / (speeds[name] * 1e9)
+                cl = min(256, max(4, int(max(0.7, 12 * rtt) / per_step) + 1))
+            for op, coeff in (("encode", enc_coeff), ("rebuild4", reb_coeff)):
+                if left() < 15:
+                    break
+                try:
+                    gbs, dt, used_chain = _chained_gbs(
+                        paths[name], coeff, words, n, cl, rtt)
+                except Exception as e:  # noqa: BLE001
+                    detail[f"{op}_{name}_error"] = str(e)[:200]
+                    _log(f"{op}/{name} n={n >> 20}MB FAILED: {e}")
+                    continue
+                key = f"{op}_{name}"
+                detail[key] = max(detail.get(key, 0.0), round(gbs, 2))
+                detail[f"{key}_{n >> 20}MB"] = round(gbs, 2)
+                speeds[name] = max(speeds.get(name, 0.0), gbs)
+                _log(f"{op}/{name} n={n >> 20}MB chain={used_chain} "
+                     f"dt={dt * 1e3:.0f}ms: {gbs:.2f} GB/s")
+                emit_cumulative(n)
+
     # batched rack-encode config (BASELINE.json 64-volume shape scaled to
-    # one chip): V volumes in one launch through the mesh "vol" axis
+    # one chip): V volumes in one launch through the mesh "vol" axis,
+    # routed through the same Pallas kernel via shard_map
     if left() > 25:
         try:
             from seaweedfs_tpu.parallel import mesh as pmesh
 
             m = pmesh.make_mesh(jax.devices()[:1])
-            vb, nb = (8, 8 << 20) if backend == "tpu" else (4, 256 << 10)
+            vb, nb = (8, 16 << 20) if backend == "tpu" else (4, 256 << 10)
             nb = min(nb, max_bytes)
             mk = jax.jit(lambda key: jax.random.randint(
                 key, (vb, k, nb), 0, 256, jnp.uint8))
             vol_data = mk(jax.random.PRNGKey(1))
             jax.block_until_ready(vol_data)
-            out = pmesh.batched_encode(m, vol_data)
-            jax.block_until_ready(out)  # compile
+            jax.block_until_ready(pmesh.batched_encode(m, vol_data))  # compile
+            # size the iteration count so the timed loop dwarfs rtt
             t0 = time.perf_counter()
-            iters = 2
+            jax.block_until_ready(pmesh.batched_encode(m, vol_data))
+            once = time.perf_counter() - t0
+            iters = max(2, int(20 * rtt / max(once, 1e-6)) + 1)
+            t0 = time.perf_counter()
             for _ in range(iters):
-                jax.block_until_ready(pmesh.batched_encode(m, vol_data))
-            dt = (time.perf_counter() - t0) / iters - rtt
-            gbs = vb * k * nb / max(dt, 1e-9) / 1e9
-            _log(f"batched encode {vb}x{nb >> 20}MB: {gbs:.2f} GB/s")
+                out = pmesh.batched_encode(m, vol_data)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / iters
+            gbs = vb * k * nb / dt / 1e9
+            if gbs > HBM_BOUND_GBPS:
+                raise ImplausibleResult(
+                    f"batched {gbs:.0f} GB/s exceeds HBM ceiling")
+            _log(f"batched encode {vb}x{nb >> 20}MB iters={iters}: "
+                 f"{gbs:.2f} GB/s")
             _emit({"stage": "batched", "batched_encode_GBps": round(gbs, 2)})
         except Exception as e:  # noqa: BLE001
             _emit({"stage": "batched",
@@ -394,6 +518,15 @@ def main() -> None:
         cpu_gbs = 0.0
         result["cpu_error"] = f"{type(e).__name__}: {e}"[:300]
         _log(f"cpu baseline FAILED: {e}")
+
+    try:
+        dr = bench_degraded_read()
+        result.update(dr)
+        _log(f"degraded read p50={dr['degraded_read_p50_ms']}ms "
+             f"p99={dr['degraded_read_p99_ms']}ms")
+    except Exception as e:  # noqa: BLE001
+        result["degraded_read_error"] = f"{type(e).__name__}: {e}"[:300]
+        _log(f"degraded-read bench FAILED: {e}")
 
     merged: dict = {}
     err: str | None = None

@@ -57,6 +57,11 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-mdir", default="",
                    help="master metadata dir (persists election "
                         "term/vote across restarts)")
+    m.add_argument("-garbageThreshold", type=float, default=0.3,
+                   help="auto-vacuum when a volume's garbage ratio "
+                        "exceeds this")
+    m.add_argument("-maintenanceIntervalS", type=float, default=900.0,
+                   help="auto-vacuum cadence seconds; 0 disables")
 
     v = sub.add_parser("volume", help="start a volume server")
     _add_common(v)
@@ -235,15 +240,55 @@ def build_parser() -> argparse.ArgumentParser:
 # ---------------------------------------------------------------------------
 
 
+def _load_master_toml() -> dict:
+    """viper-style discovery of master.toml (./, ~/.seaweedfs,
+    /etc/seaweedfs): [master.maintenance] scripts + sleep_minutes and
+    [master.sequencer] type (scaffold.go:337-371 semantics)."""
+    import tomllib
+    for d in (".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"):
+        path = os.path.join(d, "master.toml")
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as f:
+            cfg = tomllib.load(f)
+        out = {}
+        maint = cfg.get("master", {}).get("maintenance", {})
+        if maint.get("scripts"):
+            out["admin_scripts"] = [
+                ln.strip() for ln in maint["scripts"].splitlines()
+                if ln.strip() and not ln.strip().startswith("#")]
+        if "sleep_minutes" in maint:
+            out["admin_scripts_interval_s"] = \
+                float(maint["sleep_minutes"]) * 60
+        seq = cfg.get("master", {}).get("sequencer", {})
+        if seq.get("type") and seq["type"] != "memory":
+            val = seq["type"]
+            out["sequencer"] = (val if ":" in val
+                                else f"{val}:{seq.get('path', '')}")
+        from .util import glog
+        glog.info("master config loaded from %s", path)
+        return out
+    return {}
+
+
 async def _run_master(args) -> None:
     from .master.server import MasterServer
+    toml_cfg = _load_master_toml()
     m = MasterServer(ip=args.ip, port=args.port,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
                      default_replication=args.defaultReplication,
                      pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
                      peers=[p.strip() for p in args.peers.split(",")
                             if p.strip()],
-                     sequencer=args.sequencer, meta_dir=args.mdir)
+                     # explicit CLI flag beats discovered config
+                     sequencer=(args.sequencer if args.sequencer != "memory"
+                                else toml_cfg.get("sequencer", "memory")),
+                     meta_dir=args.mdir,
+                     garbage_threshold=args.garbageThreshold,
+                     maintenance_interval_s=args.maintenanceIntervalS,
+                     admin_scripts=toml_cfg.get("admin_scripts"),
+                     admin_scripts_interval_s=toml_cfg.get(
+                         "admin_scripts_interval_s", 17 * 60.0))
     await m.start()
     if args.metricsGateway:
         from .stats.metrics import push_loop

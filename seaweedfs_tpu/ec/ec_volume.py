@@ -53,6 +53,8 @@ class EcVolume:
         self.large_block = large_block
         self.small_block = small_block
         self._encoder = encoder
+        self._default_encoder = None
+        self._small_encoder = None
         self.fetch_remote = fetch_remote
         base = collection + "_" + str(vid) if collection else str(vid)
         self.base_name = os.path.join(dirname, base)
@@ -96,10 +98,23 @@ class EcVolume:
     def dat_size(self) -> int:
         return gf.DATA_SHARDS * self.shard_size
 
-    def encoder(self):
-        if self._encoder is None:
-            self._encoder = get_encoder()
-        return self._encoder
+    # below this, a recover transform is dispatch-latency-bound and the
+    # host AVX2/numpy path beats a device round trip (store_ec.go always
+    # pays the CPU cost; we pay it only where it wins)
+    SMALL_RECOVER_BYTES = 1 << 20
+
+    def encoder(self, interval_size: int | None = None):
+        if self._encoder is not None:  # explicit injection always wins
+            return self._encoder
+        if (interval_size is not None
+                and interval_size < self.SMALL_RECOVER_BYTES):
+            if getattr(self, "_small_encoder", None) is None:
+                from .encoder_cpu import CpuEncoder
+                self._small_encoder = CpuEncoder()
+            return self._small_encoder
+        if self._default_encoder is None:
+            self._default_encoder = get_encoder()
+        return self._default_encoder
 
     def _read_shard_interval(self, sid: int, offset: int, size: int) -> bytes:
         """local shard -> remote fetch -> on-the-fly reconstruct
@@ -142,7 +157,7 @@ class EcVolume:
         glog.V(3).infof("ec recover vid=%d shard=%d off=%d size=%d from %s",
                         self.vid, want_sid, offset, size, rows)
         coeff = gf.shard_rows([want_sid], rows)
-        out = _transform_buffers(self.encoder(), coeff, bufs)
+        out = _transform_buffers(self.encoder(size), coeff, bufs)
         return np.asarray(out[0], np.uint8).tobytes()
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:

@@ -40,7 +40,10 @@ class MasterServer:
                  election_timeout: tuple[float, float] = (1.0, 2.0),
                  election_pulse: float = 0.3,
                  sequencer: str = "memory",
-                 meta_dir: str = ""):
+                 meta_dir: str = "",
+                 maintenance_interval_s: float = 900.0,
+                 admin_scripts: list[str] | None = None,
+                 admin_scripts_interval_s: float = 17 * 60.0):
         self.ip = ip
         self.port = port
         self._peers = list(peers or [])
@@ -55,6 +58,14 @@ class MasterServer:
         self.volume_size_limit = volume_size_limit_mb * 1024 * 1024
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
+        # automatic maintenance (master_server.go:186-250 startAdminScripts
+        # + topology_event_handling.go:22-28 auto-vacuum): leader-only
+        # background upkeep so an unattended cluster reclaims space and
+        # runs its configured admin scripts. 0 disables either loop.
+        self.maintenance_interval_s = maintenance_interval_s
+        self.admin_scripts = [s.strip() for s in (admin_scripts or [])
+                              if s.strip()]
+        self.admin_scripts_interval_s = admin_scripts_interval_s
         self.topo = Topology(pulse_seconds=pulse_seconds)
         # -sequencer memory | file:<path> | etcd:<host:port>
         # (master.toml [master.sequencer], scaffold.go:362-371)
@@ -130,6 +141,12 @@ class MasterServer:
         self.election.adopt_max_volume_id = self._adopt_max_volume_id
         await self.election.start()
         self._tasks.append(asyncio.create_task(self._liveness_loop()))
+        if self.maintenance_interval_s > 0:
+            self._tasks.append(
+                asyncio.create_task(self._auto_vacuum_loop()))
+        if self.admin_scripts and self.admin_scripts_interval_s > 0:
+            self._tasks.append(
+                asyncio.create_task(self._admin_scripts_loop()))
 
     async def stop(self) -> None:
         if self.election:
@@ -532,6 +549,51 @@ class MasterServer:
         finally:
             self._watchers.remove(q)
         return resp
+
+    # ---- automatic maintenance (leader-only) ----
+
+    async def _auto_vacuum_loop(self) -> None:
+        """Vacuum volumes whose garbage ratio exceeds the threshold, with
+        no shell interaction (topology_event_handling.go:22-28; the
+        reference's topo.Vacuum timer)."""
+        from ..shell import volume_commands as vc
+        from ..shell.env import CommandEnv
+        while True:
+            await asyncio.sleep(self.maintenance_interval_s)
+            if not self.is_leader:
+                continue
+            try:
+                async with CommandEnv(self.url,
+                                      session=self._http) as env:
+                    res = await vc.volume_vacuum(env,
+                                                 self.garbage_threshold)
+                if res:
+                    glog.info("auto-vacuum: %s", res)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — upkeep must not die
+                glog.warning("auto-vacuum failed: %s", e)
+
+    async def _admin_scripts_loop(self) -> None:
+        """Run the configured admin script lines (master.toml
+        [master.maintenance] scripts) every sleep interval
+        (master_server.go:186-250 startAdminScripts)."""
+        from ..shell.env import CommandEnv
+        from ..shell.runner import dispatch
+        while True:
+            await asyncio.sleep(self.admin_scripts_interval_s)
+            if not self.is_leader:
+                continue
+            for line in self.admin_scripts:
+                try:
+                    async with CommandEnv(self.url,
+                                          session=self._http) as env:
+                        res = await dispatch(env, line)
+                    glog.V(1).infof("admin script %r: %s", line, res)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001
+                    glog.warning("admin script %r failed: %s", line, e)
 
     # ---- liveness sweep (topology_event_handling.go:13-21) ----
 

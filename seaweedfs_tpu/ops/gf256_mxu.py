@@ -51,34 +51,61 @@ def _plane_matrix(coeff_key: bytes, rows: int, k: int) -> np.ndarray:
     return out
 
 
-def mxu_words_transform(coeff: np.ndarray,
-                        words: list[jax.Array]) -> list[jax.Array]:
+# wm rows per matmul chunk. The bitplane unpack is a 64x expansion in
+# bf16 (32 planes x 2 bytes per input u32 word), so an unchunked 64 MB
+# shard stream would materialize a 21 GB operand (> 16 GB HBM — the
+# round-3 OOM). 2048 word-rows bound the live operand to ~170 MB while
+# keeping each dot_general large enough to saturate the systolic array.
+_CHUNK_WM = 2048
+
+
+def _mxu_block(a: np.ndarray, x: jax.Array) -> jax.Array:
+    """x: (k, cm, 128) u32 -> (rows, cm, 128) u32 via one GF(2) matmul."""
+    k, cm, lanes = x.shape
+    rows = a.shape[0] // 32
+    # unpack the 32 bitplanes of every word: (k, 32, cm, 128) — XLA fuses
+    # the shifts into the matmul operand production
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    planes = ((x[:, None] >> shifts[None, :, None, None])
+              & jnp.uint32(1))
+    # (k*32, cm*128) bf16 operand; 0/1 values are exact in bf16 and the
+    # f32-accumulated sums (<= 8k) are exact integers
+    full = planes.reshape(k * 32, -1).astype(jnp.bfloat16)
+    s = jax.lax.dot_general(
+        jnp.asarray(a, jnp.bfloat16), full,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)  # (rows*32, cm*128)
+    obits = s.astype(jnp.uint32) & jnp.uint32(1)
+    # pack 32 planes back into u32 words per output row
+    obits = obits.reshape(rows, 32, cm, lanes)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return (obits * weights[None, :, None, None]).sum(
+        axis=1, dtype=jnp.uint32)
+
+
+def mxu_words_transform(coeff: np.ndarray, words: list[jax.Array],
+                        chunk_wm: int = _CHUNK_WM) -> list[jax.Array]:
     """Same contract as gf256_pallas.gf256_words_transform: k arrays of
     (wm, 128) uint32 -> rows arrays alike, out = coeff (x) in over
-    GF(256)."""
+    GF(256). Streams the bitplane expansion through bounded chunks."""
     coeff = np.ascontiguousarray(coeff, dtype=np.uint8)
     rows, k = coeff.shape
     assert len(words) == k
     a = _plane_matrix(coeff.tobytes(), rows, k)  # (rows*32, k*32)
 
     x = jnp.stack(words, axis=0)  # (k, wm, 128) u32
-    # unpack the 32 bitplanes of every word: (k, 32, wm, 128) — XLA fuses
-    # the shifts into the matmul operand production
-    shifts = jnp.arange(32, dtype=jnp.uint32)
-    planes = ((x[:, None] >> shifts[None, :, None, None])
-              & jnp.uint32(1))
-    # (k*32, wm*128) bf16 operand; 0/1 values are exact in bf16 and the
-    # f32-accumulated sums (<= 8k) are exact integers
-    full = planes.reshape(k * 32, -1).astype(jnp.bfloat16)
-    s = jax.lax.dot_general(
-        a.astype(jnp.bfloat16), full,
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)  # (rows*32, wm*128)
-    obits = s.astype(jnp.uint32) & jnp.uint32(1)
-    # pack 32 planes back into u32 words per output row
-    wm = words[0].shape[0]
-    obits = obits.reshape(rows, 32, wm, 128)
-    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
-    packed = (obits * weights[None, :, None, None]).sum(
-        axis=1, dtype=jnp.uint32)
+    wm = x.shape[1]
+    if wm <= chunk_wm:
+        packed = _mxu_block(a, x)
+        return [packed[r] for r in range(rows)]
+    pad = (-wm) % chunk_wm
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    nchunks = x.shape[1] // chunk_wm
+    xc = jnp.moveaxis(
+        x.reshape(k, nchunks, chunk_wm, 128), 1, 0)  # (nchunks, k, cm, 128)
+    out = jax.lax.map(lambda c: _mxu_block(a, c), xc)
+    packed = jnp.moveaxis(out, 0, 1).reshape(rows, -1, 128)
+    if pad:
+        packed = packed[:, :wm]
     return [packed[r] for r in range(rows)]

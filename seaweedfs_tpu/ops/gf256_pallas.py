@@ -107,6 +107,93 @@ def gf256_words_transform(consts: np.ndarray, words: list[jax.Array],
     return call(*words)
 
 
+def _make_stacked_kernel(consts: np.ndarray):
+    """Single-ref variant: in (1, k, bm, 128), out (1, rows, bm, 128).
+    Same bitplane math as _make_kernel, but volumes/shards live in one
+    contiguous array — the layout the mesh-batched rack encode uses, so
+    no per-shard slicing copies are needed."""
+    rows, k, _ = consts.shape
+
+    def kernel(in_ref, out_ref):
+        accs = [None] * rows
+        for i in range(k):
+            xi = in_ref[0, i]  # (bm, 128) uint32
+            for j in range(8):
+                ks = [int(consts[r, i, j]) for r in range(rows)]
+                if not any(ks):
+                    continue
+                bits = jax.lax.shift_right_logical(
+                    xi, jnp.uint32(j)) & jnp.uint32(0x01010101)
+                for r in range(rows):
+                    if ks[r] == 0:
+                        continue
+                    term = bits * jnp.uint32(ks[r])
+                    accs[r] = term if accs[r] is None else accs[r] ^ term
+        for r in range(rows):
+            out_ref[0, r] = (accs[r] if accs[r] is not None
+                             else jnp.zeros_like(in_ref[0, 0]))
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=256)
+def _build_stacked_call(consts_key: bytes, rows: int, k: int, b: int,
+                        wm: int, bm: int, interpret: bool):
+    consts = np.frombuffer(consts_key, dtype=np.uint8).reshape(rows, k, 8)
+    return pl.pallas_call(
+        _make_stacked_kernel(consts),
+        out_shape=jax.ShapeDtypeStruct((b, rows, wm, _LANES), jnp.uint32),
+        grid=(b, wm // bm),
+        in_specs=[pl.BlockSpec((1, k, bm, _LANES), lambda v, i: (v, 0, i, 0))],
+        out_specs=pl.BlockSpec((1, rows, bm, _LANES),
+                               lambda v, i: (v, 0, i, 0)),
+        interpret=interpret,
+    )
+
+
+def gf256_stacked_transform(consts: np.ndarray, x: jax.Array,
+                            block_bm: int = _DEFAULT_BM,
+                            interpret: bool | None = None) -> jax.Array:
+    """Batched fast path: (B, k, wm, 128) uint32 -> (B, rows, wm, 128).
+
+    One pallas_call carries a whole batch of volumes (grid = B x wm/bm);
+    the rack-encode mesh path calls this per-device inside shard_map.
+    """
+    consts = np.ascontiguousarray(consts, dtype=np.uint8)
+    rows, k, _ = consts.shape
+    b, kk, wm, lanes = x.shape
+    assert kk == k and lanes == _LANES, (x.shape, consts.shape)
+    # bm must divide wm exactly; fall back to the gcd for word counts
+    # that aren't multiples of the preferred block (mesh callers only
+    # guarantee 512-byte alignment per device)
+    bm = min(block_bm, wm)
+    if wm % bm:
+        import math
+        bm = math.gcd(wm, bm)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    call = _build_stacked_call(consts.tobytes(), rows, k, b, wm, bm,
+                               interpret)
+    return call(x)
+
+
+def u8_to_words(d: jax.Array) -> jax.Array:
+    """(..., n) uint8 -> (..., n//512, 128) uint32 on device (free bitcast;
+    n must be a multiple of 512). Matches bytes_to_words' host layout."""
+    *batch, n = d.shape
+    assert n % (_BLOCK_BYTES) == 0, n
+    w = jax.lax.bitcast_convert_type(
+        d.reshape(*batch, n // 4, 4), jnp.uint32)
+    return w.reshape(*batch, n // _BLOCK_BYTES, _LANES)
+
+
+def words_to_u8(w: jax.Array) -> jax.Array:
+    """(..., wm, 128) uint32 -> (..., wm*512) uint8 on device."""
+    *batch, wm, lanes = w.shape
+    b8 = jax.lax.bitcast_convert_type(w, jnp.uint8)  # (..., wm, 128, 4)
+    return b8.reshape(*batch, wm * lanes * 4)
+
+
 def bytes_to_words(buf: np.ndarray | bytes, block_bm: int = _DEFAULT_BM
                    ) -> np.ndarray:
     """Host-side free-ish view of a byte stream as (wm, 128) uint32,

@@ -7,12 +7,19 @@ same shapes become a 2D jax.sharding.Mesh:
 
   axis "vol"   — data parallel over independent volumes (a rack encode:
                  64 x 30GB volumes at once)
-  axis "shard" — the 14 EC shards of each volume, sharded over ICI;
-                 rebuild all_gathers the present shards across this axis
+  axis "shard" — byte-column (sequence-parallel-style) split for encode,
+                 and the 14 EC shards of each volume for rebuild; rebuild
+                 all_gathers the present shards across this axis over ICI
 
 Encode is per-byte-column independent, so it runs with zero collectives;
 rebuild uses one all_gather over the shard axis — that is the ICI
 re-expression of the reference's goroutine+WaitGroup shard gather.
+
+Compute inside each device's shard_map block goes through the SAME Pallas
+bitplane kernel as the single-stream path (ops/gf256_pallas.py,
+gf256_stacked_transform): XLA cannot partition an opaque pallas_call over a
+sharded array, so the mesh decomposition is explicit and each device
+launches the kernel on its local (V/vol, k, n/shard) block.
 """
 
 from __future__ import annotations
@@ -25,7 +32,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ec import gf
-from ..ec.encoder_jax import _apply_bitplanes
+from ..ops.gf256_pallas import (gf256_stacked_transform, u8_to_words,
+                                words_to_u8)
+
+# byte-column quantum per device: one (1, 128) u32 lane row
+_COL_QUANTUM = 512
 
 
 def make_mesh(devices=None, vol_axis: int | None = None) -> Mesh:
@@ -49,33 +60,80 @@ def _encode_consts() -> np.ndarray:
     return gf.bitplane_constants(gf.parity_matrix())
 
 
+def _pad_axis(x: jax.Array, axis: int, quantum: int) -> jax.Array:
+    size = x.shape[axis]
+    padded = -(-size // quantum) * quantum
+    if padded == size:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, padded - size)
+    return jnp.pad(x, pads)
+
+
+def _stacked_apply(consts: np.ndarray, d: jax.Array) -> jax.Array:
+    """(V, k, n) uint8 -> (V, rows, n) uint8 through the Pallas kernel;
+    n must be a multiple of 512 (callers pad)."""
+    return words_to_u8(gf256_stacked_transform(consts, u8_to_words(d)))
+
+
+@functools.lru_cache(maxsize=64)
+def _encode_fn(mesh: Mesh):
+    """jit(shard_map) for batched encode, cached per mesh so repeated
+    calls (the bench loop, a rack encode feeding batches) don't
+    re-trace."""
+    consts = _encode_consts()
+
+    def local(d):  # d: (V/vol, k, n/shard)
+        parity = _stacked_apply(consts, d)
+        return jnp.concatenate([d, parity], axis=-2)
+
+    return jax.jit(jax.shard_map(local, mesh=mesh,
+                                 in_specs=P("vol", None, "shard"),
+                                 out_specs=P("vol", None, "shard"),
+                                 check_vma=False))
+
+
 def batched_encode(mesh: Mesh, data: jax.Array) -> jax.Array:
     """data: (V, k, n) uint8 -> (V, k+m, n) full shard sets.
 
-    V is sharded over "vol", the byte columns n over "shard" (a
-    sequence-parallel-style split: encode is columnwise independent, so both
-    axes shard with no collectives). A ragged V (rack encode: more volumes
-    than devices with an uneven tail) is zero-padded to the vol-axis
-    quantum — padding encodes to garbage that is sliced off, costing one
-    extra volume-row per launch at worst.
+    V is sharded over "vol", the byte columns n over "shard" (encode is
+    columnwise independent, so both axes shard with no collectives). A
+    ragged V (rack encode: more volumes than devices with an uneven tail)
+    is zero-padded to the vol-axis quantum — padding encodes to garbage
+    that is sliced off, costing one extra volume-row per launch at worst;
+    n pads to the 512-byte-per-device column quantum the kernel tiles on.
     """
-    consts = _encode_consts()
-
-    @jax.jit
-    def step(d):
-        parity = _apply_bitplanes(consts, d)
-        return jnp.concatenate([d, parity], axis=-2)
-
     data = jnp.asarray(data, jnp.uint8)  # no-op for device-resident input
-    v = data.shape[0]
-    vol_dim = mesh.devices.shape[0]
-    padded = -(-v // vol_dim) * vol_dim
-    if padded != v:
-        data = jnp.pad(data, ((0, padded - v), (0, 0), (0, 0)))
+    v, k, n = data.shape
+    vol_dim, shard_dim = mesh.devices.shape
+    data = _pad_axis(data, 0, vol_dim)
+    data = _pad_axis(data, 2, _COL_QUANTUM * shard_dim)
     spec = NamedSharding(mesh, P("vol", None, "shard"))
-    data = jax.device_put(data, spec)
-    out = step(data)
-    return out[:v] if padded != v else out
+    out = _encode_fn(mesh)(jax.device_put(data, spec))
+    if (out.shape[0], out.shape[2]) != (v, n):
+        out = out[:v, :, :n]
+    return out
+
+
+@functools.lru_cache(maxsize=256)
+def _rebuild_fn(mesh: Mesh, present_rows: tuple, want_rows: tuple):
+    coeff = gf.shard_rows(list(want_rows), list(present_rows))
+    consts = gf.bitplane_constants(coeff)
+    shard_dim = mesh.devices.shape[1]
+
+    def local(d):  # d: (V/vol, k/shard, n_pad)
+        gathered = jax.lax.all_gather(d, "shard", axis=1, tiled=True)
+        # rebuild only this device's column slice; out_specs reassemble
+        cols = gathered.shape[2] // shard_dim
+        me = jax.lax.axis_index("shard")
+        mine = jax.lax.dynamic_slice_in_dim(gathered, me * cols, cols,
+                                            axis=2)
+        return _stacked_apply(consts, mine)
+
+    return jax.jit(jax.shard_map(local, mesh=mesh,
+                                 in_specs=P("vol", "shard", None),
+                                 out_specs=P("vol", None, "shard"),
+                                 check_vma=False))
 
 
 def batched_rebuild(mesh: Mesh, present_rows: list[int],
@@ -84,25 +142,22 @@ def batched_rebuild(mesh: Mesh, present_rows: list[int],
     across the "shard" mesh axis; rebuild want_rows for every volume.
 
     The shard axis is all-gathered over ICI inside shard_map (the
-    goroutine-gather of store_ec.go:329-362 become one XLA collective),
-    then each device computes the missing rows for its slice of volumes.
+    goroutine-gather of store_ec.go:329-362 become one XLA collective);
+    each device then rebuilds its own slice of byte columns, so the
+    compute — the same Pallas kernel as encode — also scales over the
+    shard axis instead of being replicated.
     """
-    coeff = gf.shard_rows(list(want_rows), list(present_rows))
-    consts = gf.bitplane_constants(coeff)
     k = len(present_rows)
-
-    def local(d):  # d: (V/vol, k/shard, n)
-        gathered = jax.lax.all_gather(d, "shard", axis=1, tiled=True)
-        return _apply_bitplanes(consts, gathered)
-
-    fn = jax.shard_map(local, mesh=mesh,
-                       in_specs=P("vol", "shard", None),
-                       out_specs=P("vol", None, None),
-                       check_vma=False)
+    vol_dim, shard_dim = mesh.devices.shape
+    shards = jnp.asarray(shards, jnp.uint8)
+    v, kk, n = shards.shape
+    assert kk == k, (shards.shape, k)
+    shards = _pad_axis(shards, 0, vol_dim)
+    shards = _pad_axis(shards, 2, _COL_QUANTUM * shard_dim)
     spec = NamedSharding(mesh, P("vol", "shard", None))
-    shards = jax.device_put(jnp.asarray(shards, jnp.uint8), spec)
-    assert shards.shape[-2] == k, (shards.shape, k)
-    return jax.jit(fn)(shards)
+    fn = _rebuild_fn(mesh, tuple(present_rows), tuple(want_rows))
+    out = fn(jax.device_put(shards, spec))
+    return out[:v, :, :n]
 
 
 def full_cycle_step(mesh: Mesh, data: jax.Array,

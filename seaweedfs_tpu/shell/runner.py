@@ -44,7 +44,11 @@ def _flags(tokens: list[str]) -> dict[str, str]:
     while i < len(tokens):
         tok = tokens[i]
         if tok.startswith("-"):
-            if i + 1 < len(tokens) and not tokens[i + 1].startswith("-"):
+            if "=" in tok:  # -fullPercent=95 (reference admin-script style)
+                key, _, val = tok.lstrip("-").partition("=")
+                out[key] = val
+                i += 1
+            elif i + 1 < len(tokens) and not tokens[i + 1].startswith("-"):
                 out[tok.lstrip("-")] = tokens[i + 1]
                 i += 2
             else:
@@ -56,92 +60,102 @@ def _flags(tokens: list[str]) -> dict[str, str]:
 
 
 async def run_command(master_url: str, line: str) -> object:
+    """Interactive/CLI entry: own session + printed result."""
+    async with CommandEnv(master_url) as env:
+        res = await dispatch(env, line)
+    if res is not None:
+        print(json.dumps(res, indent=2, default=str))
+    return res
+
+
+async def dispatch(env: CommandEnv, line: str) -> object:
+    """Parse + run one shell command line against an existing env (no
+    result printing) — the master's maintenance loop drives admin scripts
+    through this (master_server.go:186-250 startAdminScripts analog)."""
     tokens = shlex.split(line)
     if not tokens:
         return None
     cmd, flags = tokens[0], _flags(tokens[1:])
-    async with CommandEnv(master_url) as env:
-        if cmd == "ec.encode":
-            vids = [int(flags["volumeId"])] if "volumeId" in flags else None
-            res = await ec.ec_encode(
-                env, collection=flags.get("collection", ""), vids=vids,
-                fullness=float(flags.get("fullPercent", 95)) / 100)
-        elif cmd == "ec.rebuild":
-            res = await ec.ec_rebuild(
-                env, collection=flags.get("collection", ""),
-                apply_changes=flags.get("force") == "true")
-        elif cmd == "ec.decode":
-            vids = [int(flags["volumeId"])] if "volumeId" in flags else None
-            res = await ec.ec_decode(
-                env, collection=flags.get("collection", ""), vids=vids)
-        elif cmd == "ec.balance":
-            res = await ec.ec_balance(
-                env, collection=flags.get("collection", ""),
-                apply_changes=flags.get("force") == "true")
-        elif cmd == "volume.vacuum":
-            res = await vc.volume_vacuum(
-                env, float(flags.get("garbageThreshold", 0.3)),
-                flags.get("collection"))
-        elif cmd == "volume.fix.replication":
-            res = await vc.volume_fix_replication(
-                env, apply_changes=flags.get("force") == "true")
-        elif cmd == "volume.balance":
-            res = await vc.volume_balance(
-                env, apply_changes=flags.get("force") == "true")
-        elif cmd == "volume.move":
-            await vc.volume_move(env, int(flags["volumeId"]),
-                                 flags.get("collection", ""),
-                                 flags["source"], flags["target"])
-            res = {"moved": flags["volumeId"]}
-        elif cmd == "volume.tier.upload":
-            res = await vc.volume_tier_upload(
-                env, int(flags["volumeId"]),
-                backend=flags.get("backend", "s3.default"),
-                keep_local=flags.get("keepLocal") == "true")
-        elif cmd == "volume.tier.download":
-            res = await vc.volume_tier_download(env, int(flags["volumeId"]))
-        elif cmd == "volume.list":
-            res = await env.list_nodes()
-        elif cmd == "collection.list":
-            res = await fs.collection_list(env)
-        elif cmd == "collection.delete":
-            res = await fs.collection_delete(env, flags["collection"])
-        elif cmd.startswith("fs."):
-            filer = flags.get("filer", "")
-            if not filer:
-                raise ValueError("fs.* commands need -filer host:port")
-            path = flags.get("path", "/")
-            if cmd == "fs.ls":
-                res = await fs.fs_ls(env, filer, path,
-                                     long_format=flags.get("l") == "true")
-            elif cmd == "fs.cat":
-                data = await fs.fs_cat(env, filer, path)
-                print(data.decode(errors="replace"))
-                return None
-            elif cmd == "fs.du":
-                res = await fs.fs_du(env, filer, path)
-            elif cmd == "fs.tree":
-                print(await fs.fs_tree(env, filer, path))
-                return None
-            elif cmd == "fs.mv":
-                res = await fs.fs_mv(env, filer, flags["from"],
-                                     flags["to"])
-            elif cmd == "fs.rm":
-                if "path" not in flags:
-                    # never let a forgotten -path default to deleting "/"
-                    raise ValueError("fs.rm requires an explicit -path")
-                res = await fs.fs_rm(env, filer, flags["path"],
-                                     recursive=flags.get(
-                                         "recursive") == "true")
-            elif cmd == "fs.meta.save":
-                res = await fs.fs_meta_save(env, filer, path,
-                                            flags.get("o", "meta.jsonl"))
-            elif cmd == "fs.meta.load":
-                res = await fs.fs_meta_load(env, filer,
-                                            flags.get("i", "meta.jsonl"))
-            else:
-                raise ValueError(f"unknown command {cmd!r}; try 'help'")
+    if cmd == "ec.encode":
+        vids = [int(flags["volumeId"])] if "volumeId" in flags else None
+        res = await ec.ec_encode(
+            env, collection=flags.get("collection", ""), vids=vids,
+            fullness=float(flags.get("fullPercent", 95)) / 100)
+    elif cmd == "ec.rebuild":
+        res = await ec.ec_rebuild(
+            env, collection=flags.get("collection", ""),
+            apply_changes=flags.get("force") == "true")
+    elif cmd == "ec.decode":
+        vids = [int(flags["volumeId"])] if "volumeId" in flags else None
+        res = await ec.ec_decode(
+            env, collection=flags.get("collection", ""), vids=vids)
+    elif cmd == "ec.balance":
+        res = await ec.ec_balance(
+            env, collection=flags.get("collection", ""),
+            apply_changes=flags.get("force") == "true")
+    elif cmd == "volume.vacuum":
+        res = await vc.volume_vacuum(
+            env, float(flags.get("garbageThreshold", 0.3)),
+            flags.get("collection"))
+    elif cmd == "volume.fix.replication":
+        res = await vc.volume_fix_replication(
+            env, apply_changes=flags.get("force") == "true")
+    elif cmd == "volume.balance":
+        res = await vc.volume_balance(
+            env, apply_changes=flags.get("force") == "true")
+    elif cmd == "volume.move":
+        await vc.volume_move(env, int(flags["volumeId"]),
+                             flags.get("collection", ""),
+                             flags["source"], flags["target"])
+        res = {"moved": flags["volumeId"]}
+    elif cmd == "volume.tier.upload":
+        res = await vc.volume_tier_upload(
+            env, int(flags["volumeId"]),
+            backend=flags.get("backend", "s3.default"),
+            keep_local=flags.get("keepLocal") == "true")
+    elif cmd == "volume.tier.download":
+        res = await vc.volume_tier_download(env, int(flags["volumeId"]))
+    elif cmd == "volume.list":
+        res = await env.list_nodes()
+    elif cmd == "collection.list":
+        res = await fs.collection_list(env)
+    elif cmd == "collection.delete":
+        res = await fs.collection_delete(env, flags["collection"])
+    elif cmd.startswith("fs."):
+        filer = flags.get("filer", "")
+        if not filer:
+            raise ValueError("fs.* commands need -filer host:port")
+        path = flags.get("path", "/")
+        if cmd == "fs.ls":
+            res = await fs.fs_ls(env, filer, path,
+                                 long_format=flags.get("l") == "true")
+        elif cmd == "fs.cat":
+            data = await fs.fs_cat(env, filer, path)
+            print(data.decode(errors="replace"))
+            return None
+        elif cmd == "fs.du":
+            res = await fs.fs_du(env, filer, path)
+        elif cmd == "fs.tree":
+            print(await fs.fs_tree(env, filer, path))
+            return None
+        elif cmd == "fs.mv":
+            res = await fs.fs_mv(env, filer, flags["from"],
+                                 flags["to"])
+        elif cmd == "fs.rm":
+            if "path" not in flags:
+                # never let a forgotten -path default to deleting "/"
+                raise ValueError("fs.rm requires an explicit -path")
+            res = await fs.fs_rm(env, filer, flags["path"],
+                                 recursive=flags.get(
+                                     "recursive") == "true")
+        elif cmd == "fs.meta.save":
+            res = await fs.fs_meta_save(env, filer, path,
+                                        flags.get("o", "meta.jsonl"))
+        elif cmd == "fs.meta.load":
+            res = await fs.fs_meta_load(env, filer,
+                                        flags.get("i", "meta.jsonl"))
         else:
             raise ValueError(f"unknown command {cmd!r}; try 'help'")
-    print(json.dumps(res, indent=2, default=str))
+    else:
+        raise ValueError(f"unknown command {cmd!r}; try 'help'")
     return res

@@ -23,7 +23,8 @@ class Cluster:
                  racks: list[tuple[str, str]] | None = None,
                  pulse: float = 0.2, max_volumes: int = 16,
                  ec_large_block: int = 16 * 1024,
-                 ec_small_block: int = 1024):
+                 ec_small_block: int = 1024,
+                 master_kwargs: dict | None = None):
         self.tmpdir = tmpdir
         self.n = n_servers
         self.racks = racks or [("dc1", "rack1")] * n_servers
@@ -37,10 +38,12 @@ class Cluster:
         self.http: aiohttp.ClientSession | None = None
         self.with_filer = False
         self.filer_chunk_size = 256 * 1024
+        self.master_kwargs = master_kwargs or {}
 
     async def __aenter__(self) -> "Cluster":
         self.master = MasterServer(port=0, pulse_seconds=self.pulse,
-                                   volume_size_limit_mb=64)
+                                   volume_size_limit_mb=64,
+                                   **self.master_kwargs)
         await self.master.start()
         for i in range(self.n):
             d = os.path.join(self.tmpdir, f"srv{i}")

@@ -1,0 +1,93 @@
+"""Master automatic maintenance: leader-only auto-vacuum + admin scripts.
+
+Reference: weed/server/master_server.go:186-250 (startAdminScripts),
+weed/topology/topology_event_handling.go:22-28 (auto-vacuum timer),
+weed/command/scaffold.go:337-361 (master.toml [master.maintenance]).
+"""
+
+import asyncio
+
+from cluster_util import Cluster, run
+
+
+async def _fill_and_delete(c: Cluster) -> tuple[str, int, int]:
+    """Write 10 needles to one volume, delete 8 — returns (vid, surviving
+    fid, dirty size)."""
+    a = await c.assign()
+    vid = a["fid"].split(",")[0]
+    fids = [a["fid"]]
+    st, _ = await c.put(a["fid"], a["url"], b"k" * 10_000)
+    assert st == 201
+    for i in range(2, 11):
+        fid = f"{vid},{i:02x}cafebabe"
+        st, _ = await c.put(fid, a["url"], b"g" * 10_000)
+        assert st == 201
+        fids.append(fid)
+    for fid in fids[2:]:
+        assert await c.delete(fid, a["url"]) == 200
+    v = c.servers[0].store.volumes[int(vid)]
+    return a, fids, v
+
+
+def test_master_auto_vacuum(tmp_path):
+    """A cluster left alone reclaims space once garbage crosses the
+    threshold — no shell interaction."""
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1, master_kwargs={
+                "maintenance_interval_s": 0.3,
+                "garbage_threshold": 0.2}) as c:
+            a, fids, v = await _fill_and_delete(c)
+            dirty = v.data_size()
+            assert v.garbage_level() > 0.2
+            for _ in range(60):  # up to ~6s for the loop to fire
+                await asyncio.sleep(0.1)
+                if v.data_size() < dirty and v.garbage_level() == 0.0:
+                    break
+            assert v.data_size() < dirty, "auto-vacuum never ran"
+            assert v.garbage_level() == 0.0
+            # survivors still readable after compaction
+            for fid in fids[:2]:
+                st, data = await c.get(fid, a["publicUrl"])
+                assert st == 200 and len(data) == 10_000
+    run(body())
+
+
+def test_master_admin_scripts(tmp_path):
+    """Configured admin script lines run on their own cadence through the
+    shell dispatcher (reference-style -k=v flags included)."""
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1, master_kwargs={
+                "maintenance_interval_s": 0,      # isolate the scripts path
+                "admin_scripts": [
+                    "volume.vacuum -garbageThreshold=0.2"],
+                "admin_scripts_interval_s": 0.3}) as c:
+            a, fids, v = await _fill_and_delete(c)
+            dirty = v.data_size()
+            for _ in range(60):
+                await asyncio.sleep(0.1)
+                if v.data_size() < dirty:
+                    break
+            assert v.data_size() < dirty, "admin script never ran"
+    run(body())
+
+
+def test_master_toml_parsing(tmp_path, monkeypatch):
+    """master.toml discovery feeds [master.maintenance] into the server
+    config (scaffold.go:337-361)."""
+    from seaweedfs_tpu.cli import _load_master_toml
+
+    (tmp_path / "master.toml").write_text(
+        '[master.maintenance]\n'
+        'scripts = """\n'
+        '  volume.fix.replication\n'
+        '  ec.rebuild -force\n'
+        '"""\n'
+        'sleep_minutes = 3\n'
+        '[master.sequencer]\n'
+        'type = "memory"\n')
+    monkeypatch.chdir(tmp_path)
+    cfg = _load_master_toml()
+    assert cfg["admin_scripts"] == ["volume.fix.replication",
+                                    "ec.rebuild -force"]
+    assert cfg["admin_scripts_interval_s"] == 180.0
+    assert "sequencer" not in cfg  # memory = default, not forwarded

@@ -82,6 +82,22 @@ def test_mxu_multiple_wm_blocks(rng):
         assert np.array_equal(got[p], want[p]), p
 
 
+def test_mxu_chunked_streaming(rng):
+    """wm > chunk_wm streams through lax.map chunks (the blocking that
+    keeps the 32x bitplane expansion off HBM at 64MB+ shard sizes),
+    including a ragged final chunk."""
+    cpu = CpuEncoder(use_native=False)
+    n = 5 * 8 * 512  # wm=40: chunk_wm=16 -> 2 full chunks + ragged 8
+    data = [rng.integers(0, 256, n).astype(np.uint8) for _ in range(10)]
+    want = cpu.encode(list(data))[10:]
+    words = [bytes_to_words(b, block_bm=8) for b in data]
+    outs = mxu_words_transform(np.asarray(gf.parity_matrix(), np.uint8),
+                               words, chunk_wm=16)
+    got = [words_to_bytes(np.asarray(o), n) for o in outs]
+    for p in range(4):
+        assert np.array_equal(got[p], want[p]), p
+
+
 def test_pipeline_with_mxu_method(rng, tmp_path, monkeypatch):
     """SWTPU_EC_METHOD=mxu drives the whole file pipeline through the MXU
     formulation (pipeline.py branch) and must produce identical shards."""

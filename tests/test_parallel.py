@@ -26,6 +26,21 @@ def test_batched_encode_matches_oracle(eight_devices):
             assert np.array_equal(out[v, sid], want[sid]), (v, sid)
 
 
+def test_batched_encode_odd_word_count(eight_devices):
+    """Per-device word counts that aren't multiples of the preferred
+    Pallas block (wm=264 on a 1-wide shard axis) must still tile — the
+    kernel falls back to a gcd block size."""
+    import jax
+    m = pmesh.make_mesh(jax.devices()[:1])
+    rng = np.random.default_rng(7)
+    n = 264 * 512  # wm=264: not a multiple of bm=256
+    data = rng.integers(0, 256, (1, 10, n)).astype(np.uint8)
+    out = np.asarray(pmesh.batched_encode(m, data))
+    want = CpuEncoder().encode([r for r in data[0]])
+    for sid in range(14):
+        assert np.array_equal(out[0, sid], want[sid]), sid
+
+
 def test_full_cycle_rebuild(eight_devices):
     m = pmesh.make_mesh(eight_devices)
     rng = np.random.default_rng(1)
