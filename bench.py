@@ -246,7 +246,8 @@ def _chained_gbs(transform, consts, words, n: int, chain_len: int,
         return chain
 
     for _attempt in range(4):
-        chain = build(chain_len)
+        measured_chain = chain_len  # dt below belongs to THIS length
+        chain = build(measured_chain)
         float(chain(*words))  # compile
         iters = 2
         t0 = time.perf_counter()
@@ -261,6 +262,7 @@ def _chained_gbs(transform, consts, words, n: int, chain_len: int,
         chain_len = min(256, chain_len * grow)
         _log(f"  chain too short (dt={dt * 1e3:.0f}ms vs rtt="
              f"{rtt * 1e3:.0f}ms); growing chain to {chain_len}")
+    chain_len = measured_chain
     per_step = ((dt - rtt) if dt > 10 * rtt else dt) / chain_len
     gbs = k * n / per_step / 1e9
     if gbs > HBM_BOUND_GBPS:

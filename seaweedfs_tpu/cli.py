@@ -51,9 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(enables leader election)")
     m.add_argument("-metricsGateway", default="",
                    help="prometheus push-gateway host:port")
-    m.add_argument("-sequencer", default="memory",
+    m.add_argument("-sequencer", default=None,
                    help="file-id allocator: memory | file:<path> | "
-                        "etcd:<host:port>")
+                        "etcd:<host:port> (default: master.toml "
+                        "[master.sequencer], else memory)")
     m.add_argument("-mdir", default="",
                    help="master metadata dir (persists election "
                         "term/vote across restarts)")
@@ -110,7 +111,9 @@ def build_parser() -> argparse.ArgumentParser:
     fr = sub.add_parser("filer.replicate",
                         help="replay filer meta events into a sink")
     fr.add_argument("-notify", required=True,
-                    help="subscription input: file:<path> | sqlite:<path>")
+                    help="subscription input: file:<path> | sqlite:<path> "
+                         "| kafka:<hosts>/<topic>[@offsets] | "
+                         "sqs:<region>/<queue> | pubsub:<project>/<topic>")
     fr.add_argument("-sourceMaster", required=True,
                     help="source cluster master host:port")
     fr.add_argument("-sourceDir", default="/",
@@ -280,8 +283,10 @@ async def _run_master(args) -> None:
                      pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
                      peers=[p.strip() for p in args.peers.split(",")
                             if p.strip()],
-                     # explicit CLI flag beats discovered config
-                     sequencer=(args.sequencer if args.sequencer != "memory"
+                     # explicit CLI flag beats discovered config (None =
+                     # flag not given, so even an explicit `-sequencer
+                     # memory` overrides a master.toml sequencer)
+                     sequencer=(args.sequencer if args.sequencer is not None
                                 else toml_cfg.get("sequencer", "memory")),
                      meta_dir=args.mdir,
                      garbage_threshold=args.garbageThreshold,
@@ -358,6 +363,40 @@ def _make_queue(spec: str):
         return SqliteQueue(path)
     raise SystemExit(f"bad -notify spec {spec!r}; "
                      f"use log | file:<path> | sqlite:<path>")
+
+
+def _make_subscription(spec: str):
+    """filer.replicate consumption input: the file/sqlite queues plus the
+    broker subscribers (replication/sub.py, driver-gated like the
+    publishers). Broker specs:
+      kafka:<host1,host2>/<topic>[@offset_file]
+      sqs:<region>/<queue_name>
+      pubsub:<project_id>/<topic>
+    """
+    kind, _, rest = spec.partition(":")
+    if kind in ("file", "sqlite"):  # NOT "log": it records, can't replay
+        return _make_queue(spec)
+    from .replication import sub as rsub
+    if kind == "kafka":
+        hosts, _, rest2 = rest.partition("/")
+        topic, _, offset_file = rest2.partition("@")
+        q = rsub.KafkaInput()
+        q.initialize({"hosts": hosts.split(","), "topic": topic,
+                      "offset_file": offset_file or None})
+        return q
+    if kind == "sqs":
+        region, _, name = rest.partition("/")
+        q = rsub.SqsInput()
+        q.initialize({"region": region, "sqs_queue_name": name})
+        return q
+    if kind == "pubsub":
+        project, _, topic = rest.partition("/")
+        q = rsub.GooglePubSubInput()
+        q.initialize({"project_id": project, "topic": topic})
+        return q
+    raise SystemExit(f"bad -notify spec {spec!r}; use file:<path> | "
+                     f"sqlite:<path> | kafka:<hosts>/<topic>[@offsets] | "
+                     f"sqs:<region>/<queue> | pubsub:<project>/<topic>")
 
 
 def _make_sink(spec: str, sink_dir: str):
@@ -455,7 +494,7 @@ async def _run_filer_replicate(args) -> None:
     from .replication.replicator import Replicator
     from .replication.runner import replicate_from_queue
     from .replication.source import FilerSource
-    queue = _make_queue(args.notify)
+    queue = _make_subscription(args.notify)
     sink = _make_sink(args.sink, args.sinkDir)
     async with FilerSource(args.sourceMaster, args.sourceDir) as src:
         await sink.start()
@@ -467,6 +506,9 @@ async def _run_filer_replicate(args) -> None:
                 print(f"replicated {n} events")
         finally:
             await sink.close()
+            closer = getattr(queue, "close", None)
+            if closer is not None:
+                closer()
 
 
 async def _run_s3(args) -> None:

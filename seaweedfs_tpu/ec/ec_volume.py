@@ -108,7 +108,7 @@ class EcVolume:
             return self._encoder
         if (interval_size is not None
                 and interval_size < self.SMALL_RECOVER_BYTES):
-            if getattr(self, "_small_encoder", None) is None:
+            if self._small_encoder is None:
                 from .encoder_cpu import CpuEncoder
                 self._small_encoder = CpuEncoder()
             return self._small_encoder

@@ -37,27 +37,48 @@ async def replicate_from_queue(queue, replicator: Replicator,
                                once: bool = False) -> int:
     """Drain the queue into the sink; returns events applied. With
     once=True, process the current backlog and return (for tests and
-    batch catch-up runs)."""
+    batch catch-up runs).
+
+    Inputs: FileQueue/SqliteQueue track consumption in progress_path;
+    broker inputs (replication/sub.py: kafka/SQS/Pub-Sub) manage their
+    own resume state (kafka offset file / broker acknowledgements) and
+    are committed only AFTER the whole batch replicated — at-least-once,
+    like the reference's success-callback ordering
+    (filer_replication.go:37-130)."""
+    from .sub import NotificationInput
+
     offset = _load_progress(progress_path)
     applied = 0
     while True:
+        tokens = None
         if isinstance(queue, FileQueue):
             msgs, offset = queue.read_from(offset)
-            batch = msgs
+            batch = [(m["key"], m["event"]) for m in msgs]
         elif isinstance(queue, SqliteQueue):
             rows = queue.read_after(offset)
-            batch = [m for _, m in rows]
+            batch = [(m["key"], m["event"]) for _, m in rows]
             if rows:
                 offset = rows[-1][0]
+        elif isinstance(queue, NotificationInput):
+            # broker polls are synchronous network I/O: keep them off
+            # the event loop that the source/sink sessions share
+            loop = asyncio.get_running_loop()
+            items = await loop.run_in_executor(None, queue.receive_batch)
+            batch = [(key, event) for key, event, _ in items]
+            tokens = [tok for _, _, tok in items]
         else:
             raise ValueError(
                 f"unsupported subscription input {type(queue).__name__}; "
-                f"use a file or sqlite queue")
-        for msg in batch:
-            await replicator.replicate(msg["key"], msg["event"])
+                f"use a file/sqlite queue or a replication.sub input")
+        for key, event in batch:
+            await replicator.replicate(key, event)
             applied += 1
         if batch:
-            _save_progress(progress_path, offset)
+            if tokens is not None:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, queue.commit, tokens)
+            else:
+                _save_progress(progress_path, offset)
         if once:
             return applied
         await asyncio.sleep(poll_interval)

@@ -56,6 +56,8 @@ class VolumeServer:
         self._runner: web.AppRunner | None = None
         self._tasks: list[asyncio.Task] = []
         self._http: aiohttp.ClientSession | None = None
+        from .ec_locations import EcLocationCache
+        self._ec_locations = EcLocationCache(self._lookup_ec_locations)
         self.app = self._build_app()
         store.fetch_remote_shard = None  # wired after start (needs loop)
 
@@ -133,23 +135,30 @@ class VolumeServer:
             await self._runner.cleanup()
         self.store.close()
 
-    def _sync_fetch_remote_shard(self, vid: int, shard_id: int,
-                                 offset: int, size: int) -> bytes | None:
-        """Blocking remote shard interval fetch via the master's EC
-        location registry; called from executor threads only."""
+    def _lookup_ec_locations(self, vid: int) -> dict | None:
+        """One master /vol/ec_lookup call (executor threads only)."""
         import json as _json
         import urllib.request
-        ctx = tls.client_ctx()
-        try:
-            with urllib.request.urlopen(
-                    tls.url(self.master_url, f"/vol/ec_lookup?volumeId={vid}"),
-                    timeout=10, context=ctx) as r:
-                shards = _json.load(r)["shards"]
-        except Exception:
+        with urllib.request.urlopen(
+                tls.url(self.master_url, f"/vol/ec_lookup?volumeId={vid}"),
+                timeout=10, context=tls.client_ctx()) as r:
+            return _json.load(r)["shards"]
+
+    def _sync_fetch_remote_shard(self, vid: int, shard_id: int,
+                                 offset: int, size: int) -> bytes | None:
+        """Blocking remote shard interval fetch; locations come from the
+        staleness-tiered cache (store_ec.go:218-259) so a degraded-read
+        burst costs one master lookup, not one per interval."""
+        import urllib.request
+        shards = self._ec_locations.get(vid)
+        if shards is None:
             return None
+        ctx = tls.client_ctx()
+        attempted = False
         for target in shards.get(str(shard_id), []):
             if target == self.url:
                 continue
+            attempted = True
             try:
                 with urllib.request.urlopen(
                         tls.url(target,
@@ -162,6 +171,12 @@ class VolumeServer:
                         return data
             except Exception:
                 continue
+        if attempted:
+            # a listed holder failed to serve: the map moved under us,
+            # make the next read re-resolve. A shard with NO listed
+            # holders is a correct map (lost shard) — don't invalidate,
+            # the caller reconstructs instead.
+            self._ec_locations.invalidate(vid)
         return None
 
     # ---- heartbeat loop ----
