@@ -83,6 +83,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="S3-compatible endpoint for volume.tier.upload "
                         "(configures backend id s3.default)")
     v.add_argument("-tierS3Bucket", default="volume-tier")
+    v.add_argument("-tierMmapDir", default="",
+                   help="directory (tmpfs/ramdisk for an in-memory tier) "
+                        "for volume.tier.upload -backend mmap.default")
 
     f = sub.add_parser("filer", help="start a filer server")
     _add_common(f)
@@ -309,11 +312,15 @@ async def _run_volume(args) -> None:
     maxes = [int(x) for x in args.max.split(",")]
     if len(maxes) == 1:
         maxes = maxes * len(dirs)
+    tier_cfg = {}
     if args.tierS3Endpoint:
+        tier_cfg["s3"] = {"default": {"endpoint": args.tierS3Endpoint,
+                                      "bucket": args.tierS3Bucket}}
+    if args.tierMmapDir:
+        tier_cfg["mmap"] = {"default": {"dir": args.tierMmapDir}}
+    if tier_cfg:
         from .storage.backend import load_backends
-        load_backends({"s3": {"default": {
-            "endpoint": args.tierS3Endpoint,
-            "bucket": args.tierS3Bucket}}})
+        load_backends(tier_cfg)
     store = Store(dirs, max_volume_counts=maxes,
                   compaction_bytes_per_second=args.compactionMBps
                   * 1024 * 1024,

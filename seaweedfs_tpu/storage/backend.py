@@ -158,6 +158,107 @@ class S3BackendStorage:
             raise BackendError(f"s3 delete {key}: {e}") from e
 
 
+# ---- memory-mapped local backend ----
+
+
+class MmapBackendStorageFile:
+    """read_at served straight from a read-only memory map: the OS page
+    cache holds hot volume pages, and a pread-style slice is a memcpy,
+    no syscall-per-read (reference: weed/storage/backend/memory_map/
+    memory_map_backend.go, re-expressed POSIX-first instead of the
+    reference's Windows CreateFileMapping path)."""
+
+    def __init__(self, path: str):
+        import mmap
+        self._path = path
+        self._f = None
+        try:
+            self._f = open(path, "rb")
+            self._size = os.fstat(self._f.fileno()).st_size
+            self._mm = (mmap.mmap(self._f.fileno(), self._size,
+                                  prot=mmap.PROT_READ)
+                        if self._size else None)
+        except OSError as e:
+            if self._f is not None:
+                self._f.close()
+            raise BackendError(f"mmap open {path}: {e}") from e
+
+    def read_at(self, offset: int, size: int) -> bytes:
+        if self._mm is None or offset >= self._size:
+            return b""
+        return self._mm[offset:offset + size]
+
+    def size(self) -> int:
+        return self._size
+
+    def name(self) -> str:
+        return f"mmap://{self._path}"
+
+    def close(self) -> None:
+        if self._mm is not None:
+            self._mm.close()
+        self._f.close()
+
+
+class MmapBackendStorage:
+    """Local-directory tier with memory-mapped reads — point it at a
+    tmpfs/ramdisk for an in-memory tier, or a big slow disk for a cold
+    tier. Second in-tree BackendStorage (backend.go factory plurality)."""
+
+    def __init__(self, backend_id: str, dirname: str):
+        self.id = backend_id
+        self.dir = dirname
+        os.makedirs(dirname, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        # keys look like "1.dat.<random>"; keep them flat
+        return os.path.join(self.dir, key.replace("/", "_"))
+
+    def new_storage_file(self, key: str,
+                         known_size: int = -1) -> MmapBackendStorageFile:
+        return MmapBackendStorageFile(self._path(key))
+
+    def copy_file(self, local_path: str, key: str) -> int:
+        dst = self._path(key)
+        tmp = dst + ".tmp"
+        # durable before rename: tier_upload deletes the only local copy
+        # right after this returns, so the bytes must be ON the tier
+        # medium, not just in page cache (the S3 backend gets the same
+        # guarantee from the server ack)
+        with open(local_path, "rb") as src, open(tmp, "wb") as out:
+            while True:
+                chunk = src.read(1 << 20)
+                if not chunk:
+                    break
+                out.write(chunk)
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(tmp, dst)
+        dfd = os.open(self.dir, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+        return os.path.getsize(dst)
+
+    def download_file(self, key: str, local_path: str) -> int:
+        import shutil
+        src = self._path(key)
+        try:
+            shutil.copyfile(src, local_path)
+        except OSError as e:
+            raise BackendError(f"mmap download {key}: {e}") from e
+        return os.path.getsize(local_path)
+
+    def delete_file(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            raise BackendError(f"mmap delete {key}: {e}") from e
+
+
 # ---- registry (backend.go:24-45 factory map + LoadConfiguration) ----
 
 _FACTORIES: dict[str, Callable[..., BackendStorage]] = {}
@@ -173,6 +274,10 @@ register_backend_factory(
     "s3", lambda backend_id, conf: S3BackendStorage(
         backend_id, conf["endpoint"], conf["bucket"],
         conf.get("storage_class", "")))
+
+register_backend_factory(
+    "mmap", lambda backend_id, conf: MmapBackendStorage(
+        backend_id, conf["dir"]))
 
 
 def load_backends(config: dict) -> None:
