@@ -225,21 +225,28 @@ class MmapBackendStorage:
         # right after this returns, so the bytes must be ON the tier
         # medium, not just in page cache (the S3 backend gets the same
         # guarantee from the server ack)
-        with open(local_path, "rb") as src, open(tmp, "wb") as out:
-            while True:
-                chunk = src.read(1 << 20)
-                if not chunk:
-                    break
-                out.write(chunk)
-            out.flush()
-            os.fsync(out.fileno())
-        os.replace(tmp, dst)
-        dfd = os.open(self.dir, os.O_RDONLY)
         try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
-        return os.path.getsize(dst)
+            with open(local_path, "rb") as src, open(tmp, "wb") as out:
+                while True:
+                    chunk = src.read(1 << 20)
+                    if not chunk:
+                        break
+                    out.write(chunk)
+                out.flush()
+                os.fsync(out.fileno())
+            os.replace(tmp, dst)
+            dfd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+            return os.path.getsize(dst)
+        except OSError as e:
+            try:  # don't pin tier space with a partial temp file
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise BackendError(f"mmap upload {key}: {e}") from e
 
     def download_file(self, key: str, local_path: str) -> int:
         import shutil
