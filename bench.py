@@ -254,7 +254,9 @@ def _chained_gbs(transform, consts, words, n: int, chain_len: int,
         for _ in range(iters):
             float(chain(*words))
         dt = (time.perf_counter() - t0) / iters
-        if dt > 10 * rtt or chain_len >= 256:
+        # 5x rtt is enough to report honestly (no rtt subtraction below
+        # 10x); growing a slow path's chain just burns recompiles
+        if dt > 5 * rtt or chain_len >= 256:
             break
         # chain too short to separate from dispatch latency: grow it so
         # kernel time dominates instead of subtracting into the noise
@@ -349,14 +351,26 @@ def child_main() -> None:
             stage_res["value"] = min(enc, reb)
         _emit(stage_res)
 
-    def run_stage(n: int, chain_len: int) -> None:
+    def gen_words(n: int, seed: int = 0) -> list:
         # generate stripes ON DEVICE: device_put of NxGB through the axon
         # tunnel takes minutes, PRNG keys are a few bytes
         make = jax.jit(
             lambda key: jax.random.bits(key, (n // 512, 128), jnp.uint32))
-        keys = jax.random.split(jax.random.PRNGKey(0), k)
-        words = [make(keys[i]) for i in range(k)]
+        words = [make(k_) for k_ in
+                 jax.random.split(jax.random.PRNGKey(seed), k)]
         jax.block_until_ready(words)
+        return words
+
+    def chain_for(name: str, n: int, default: int) -> int:
+        # size the chain from the measured speed so the timed region
+        # lands near max(0.7s, 12*rtt) on the first try
+        if name not in speeds:
+            return default
+        per_step = k * n / (speeds[name] * 1e9)
+        return min(256, max(4, int(max(0.7, 12 * rtt) / per_step) + 1))
+
+    def run_stage(n: int, chain_len: int) -> None:
+        words = gen_words(n)
         best = max(speeds.values(), default=0.0)
         for name in sorted(good, key=lambda p: -speeds.get(p, 1e9)):
             if speeds.get(name, 1e9) < best / 5:
@@ -365,12 +379,7 @@ def child_main() -> None:
                 _log(f"skipping {name} at {n >> 20}MB (lost race: "
                      f"{speeds[name]:.1f} vs {best:.1f} GB/s)")
                 continue
-            cl = chain_len
-            if name in speeds:
-                # size the chain from the measured speed so the timed
-                # region lands near max(0.7s, 12*rtt) on the first try
-                per_step = k * n / (speeds[name] * 1e9)
-                cl = min(256, max(4, int(max(0.7, 12 * rtt) / per_step) + 1))
+            cl = chain_for(name, n, chain_len)
             for op, coeff in (("encode", enc_coeff), ("rebuild4", reb_coeff)):
                 if left() < 15:
                     return
@@ -433,14 +442,8 @@ def child_main() -> None:
         only the default kernel configuration; tuning data just informs
         moving the default in a future round."""
         n = min(16 << 20, max_bytes)
-        make = jax.jit(
-            lambda key: jax.random.bits(key, (n // 512, 128), jnp.uint32))
-        words = [make(k_) for k_ in
-                 jax.random.split(jax.random.PRNGKey(2), k)]
-        jax.block_until_ready(words)
-        base = speeds.get("vpu", 10.0)
-        cl = min(256, max(4, int(max(0.7, 12 * rtt)
-                                 / (k * n / (base * 1e9))) + 1))
+        words = gen_words(n, seed=2)
+        cl = chain_for("vpu", n, 32)
         for bm in (128, 512, 1024):
             if left() < 40:
                 return

@@ -242,10 +242,15 @@ class MmapBackendStorage:
                 os.close(dfd)
             return os.path.getsize(dst)
         except OSError as e:
-            try:  # don't pin tier space with a partial temp file
-                os.remove(tmp)
-            except OSError:
-                pass
+            # don't pin tier space: a failed upload must leave neither a
+            # partial temp file nor (when the rename already happened and
+            # a later fsync failed) an orphaned dst — the caller reports
+            # failure and retries under a fresh key
+            for leftover in (tmp, dst):
+                try:
+                    os.remove(leftover)
+                except OSError:
+                    pass
             raise BackendError(f"mmap upload {key}: {e}") from e
 
     def download_file(self, key: str, local_path: str) -> int:

@@ -201,3 +201,45 @@ def test_batched_encode_matches_serial(tmp_path):
             with open(sbase + pl.to_ext(sid), "rb") as a, \
                     open(bbase + pl.to_ext(sid), "rb") as b:
                 assert a.read() == b.read(), (sbase, sid)
+
+
+def test_overlapped_pipeline_error_propagates(tmp_path):
+    """A transform failure mid-stream must raise out of write_ec_files
+    (not deadlock the reader/writer threads) and must not be swallowed."""
+    import threading
+
+    d = str(tmp_path)
+    v = Volume(d, "", 7)
+    rng = random.Random(3)
+    for i in range(1, 30):
+        v.write_needle(Needle(cookie=1, id=i,
+                              data=bytes(rng.getrandbits(8)
+                                         for _ in range(2000))))
+    v.close()
+    base = os.path.join(d, "7")
+
+    class ExplodingEncoder:
+        """Duck-typed encoder: neither Jax nor Cpu, so the pipeline uses
+        the numpy fallback — patched to throw on the 3rd batch."""
+
+    calls = {"n": 0}
+    from seaweedfs_tpu.ec import pipeline as plmod
+    orig = plmod._transform_buffers_async
+
+    def exploding(encoder, coeff, buffers):
+        calls["n"] += 1
+        if calls["n"] == 3:
+            raise RuntimeError("kaboom")
+        return orig(encoder, coeff, buffers)
+
+    plmod._transform_buffers_async = exploding
+    try:
+        before = threading.active_count()
+        with pytest.raises(RuntimeError, match="kaboom"):
+            pl.write_ec_files(base, encoder=ExplodingEncoder(),
+                              large_block=LB, small_block=SB,
+                              buffer_size=SB)
+        # pipeline threads joined, none leaked
+        assert threading.active_count() <= before
+    finally:
+        plmod._transform_buffers_async = orig
