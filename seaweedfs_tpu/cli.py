@@ -86,6 +86,10 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("-tierMmapDir", default="",
                    help="directory (tmpfs/ramdisk for an in-memory tier) "
                         "for volume.tier.upload -backend mmap.default")
+    v.add_argument("-ecBackend", default="auto",
+                   choices=("auto", "tpu", "cpu"),
+                   help="erasure-coding engine (the reference-noted "
+                        "-ec.backend switch): auto = tpu when attached")
 
     f = sub.add_parser("filer", help="start a filer server")
     _add_common(f)
@@ -312,6 +316,9 @@ async def _run_volume(args) -> None:
     maxes = [int(x) for x in args.max.split(",")]
     if len(maxes) == 1:
         maxes = maxes * len(dirs)
+    # the flag is authoritative: an explicit `-ecBackend auto` clears an
+    # inherited pin from the parent environment
+    os.environ["SWTPU_EC_BACKEND"] = args.ecBackend
     tier_cfg = {}
     if args.tierS3Endpoint:
         tier_cfg["s3"] = {"default": {"endpoint": args.tierS3Endpoint,

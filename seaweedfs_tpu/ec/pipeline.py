@@ -36,7 +36,24 @@ def to_ext(shard_id: int) -> str:
 
 
 def get_encoder(backend: str = "auto"):
-    """backend: 'tpu' | 'cpu' | 'auto' (tpu if a TPU is attached)."""
+    """backend: 'tpu' | 'cpu' | 'auto' (tpu if a TPU is attached).
+
+    The BASELINE `-ec.backend` switch: the volume CLI's -ecBackend flag
+    (exported as SWTPU_EC_BACKEND) overrides 'auto', so an operator can
+    pin the CPU path on TPU hosts or fail fast when the TPU is absent."""
+    if backend == "auto":
+        backend = os.environ.get("SWTPU_EC_BACKEND", "auto").lower()
+    if backend not in ("auto", "tpu", "cpu"):
+        raise ValueError(
+            f"unknown EC backend {backend!r}: use auto | tpu | cpu")
+    if backend == "tpu":
+        # an explicit pin fails fast instead of silently degrading to
+        # XLA-on-CPU when the accelerator is absent
+        import jax
+        if jax.default_backend() != "tpu":
+            raise RuntimeError(
+                "EC backend pinned to 'tpu' but no TPU is attached "
+                f"(jax backend: {jax.default_backend()})")
     if backend == "auto":
         try:
             import jax

@@ -243,3 +243,22 @@ def test_overlapped_pipeline_error_propagates(tmp_path):
         assert threading.active_count() <= before
     finally:
         plmod._transform_buffers_async = orig
+
+
+def test_ec_backend_env_override(monkeypatch):
+    """SWTPU_EC_BACKEND (the volume CLI's -ecBackend flag) pins the
+    engine choice regardless of the attached accelerator."""
+    from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
+
+    monkeypatch.setenv("SWTPU_EC_BACKEND", "cpu")
+    assert isinstance(pl.get_encoder(), CpuEncoder)
+    # a tpu pin on a host without a TPU fails fast (tests run on cpu)
+    monkeypatch.setenv("SWTPU_EC_BACKEND", "tpu")
+    with pytest.raises(RuntimeError, match="no TPU is attached"):
+        pl.get_encoder()
+    # explicit argument still wins over the env
+    assert isinstance(pl.get_encoder("cpu"), CpuEncoder)
+    # garbage values are rejected, not silently mapped to cpu
+    monkeypatch.setenv("SWTPU_EC_BACKEND", "gpu")
+    with pytest.raises(ValueError, match="unknown EC backend"):
+        pl.get_encoder()
