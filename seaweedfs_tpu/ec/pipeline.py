@@ -329,36 +329,22 @@ def write_ec_files_batched(base_names: list[str], encoder=None,
                           for i in range(gf.TOTAL_SHARDS)]
             shard_pos = 0
             with open(dat_path, "rb") as f:
-                remaining = dat_size
-                processed = 0
-                large_row = large_block * gf.DATA_SHARDS
-                rows: list[tuple[int, int]] = []
-                while remaining > large_row:
-                    rows.append((processed, large_block))
-                    processed += large_row
-                    remaining -= large_row
-                while remaining > 0:
-                    rows.append((processed, small_block))
-                    processed += small_block * gf.DATA_SHARDS
-                    remaining -= small_block * gf.DATA_SHARDS
-                for start, block_size in rows:
-                    buf = min(buffer_size, block_size)
-                    assert block_size % buf == 0, (block_size, buf)
-                    for b in range(block_size // buf):
-                        buffers = []
-                        for i in range(gf.DATA_SHARDS):
-                            f.seek(start + block_size * i + b * buf)
-                            raw = f.read(buf)
-                            if len(raw) < buf:
-                                raw += b"\x00" * (buf - len(raw))
-                            buffers.append(np.frombuffer(raw, np.uint8))
-                            outs[base][i].write(raw)
-                        pending.setdefault(buf, []).append(
-                            (buffers, base, shard_pos))
-                        pending_refs[base] = pending_refs.get(base, 0) + 1
-                        shard_pos += buf
-                        if len(pending[buf]) >= batch_volumes:
-                            flush(buf)
+                for start, block_size, buf, b in _iter_row_batches(
+                        dat_size, large_block, small_block, buffer_size):
+                    buffers = []
+                    for i in range(gf.DATA_SHARDS):
+                        f.seek(start + block_size * i + b * buf)
+                        raw = f.read(buf)
+                        if len(raw) < buf:
+                            raw += b"\x00" * (buf - len(raw))
+                        buffers.append(np.frombuffer(raw, np.uint8))
+                        outs[base][i].write(raw)
+                    pending.setdefault(buf, []).append(
+                        (buffers, base, shard_pos))
+                    pending_refs[base] = pending_refs.get(base, 0) + 1
+                    shard_pos += buf
+                    if len(pending[buf]) >= batch_volumes:
+                        flush(buf)
             fully_enqueued.add(base)
             maybe_close(base)
         for buf_len in list(pending):

@@ -43,26 +43,36 @@ _DEFAULT_BM = 256
 _BLOCK_BYTES = _LANES * 4
 
 
+def _accumulate_bitplanes(consts: np.ndarray, read_shard) -> list:
+    """Shared kernel body: XOR-accumulate the 8 bitplanes of each of k
+    input blocks against the per-row constants. read_shard(i) -> the
+    i-th shard's (bm, 128) uint32 block; returns one accumulator per
+    output row (None where a row's coefficients are all zero)."""
+    rows, k, _ = consts.shape
+    accs = [None] * rows
+    for i in range(k):
+        xi = read_shard(i)
+        for j in range(8):
+            ks = [int(consts[r, i, j]) for r in range(rows)]
+            if not any(ks):
+                continue
+            bits = jax.lax.shift_right_logical(
+                xi, jnp.uint32(j)) & jnp.uint32(0x01010101)
+            for r in range(rows):
+                if ks[r] == 0:
+                    continue
+                term = bits * jnp.uint32(ks[r])
+                accs[r] = term if accs[r] is None else accs[r] ^ term
+    return accs
+
+
 def _make_kernel(consts: np.ndarray):
     """consts: (rows, k, 8) uint8 bitplane constants (host)."""
     rows, k, _ = consts.shape
 
     def kernel(*refs):
         ins, outs = refs[:k], refs[k:]
-        accs = [None] * rows
-        for i in range(k):
-            xi = ins[i][...]  # (bm, 128) uint32
-            for j in range(8):
-                ks = [int(consts[r, i, j]) for r in range(rows)]
-                if not any(ks):
-                    continue
-                bits = jax.lax.shift_right_logical(
-                    xi, jnp.uint32(j)) & jnp.uint32(0x01010101)
-                for r in range(rows):
-                    if ks[r] == 0:
-                        continue
-                    term = bits * jnp.uint32(ks[r])
-                    accs[r] = term if accs[r] is None else accs[r] ^ term
+        accs = _accumulate_bitplanes(consts, lambda i: ins[i][...])
         for r in range(rows):
             outs[r][...] = (accs[r] if accs[r] is not None
                             else jnp.zeros_like(ins[0][...]))
@@ -115,20 +125,7 @@ def _make_stacked_kernel(consts: np.ndarray):
     rows, k, _ = consts.shape
 
     def kernel(in_ref, out_ref):
-        accs = [None] * rows
-        for i in range(k):
-            xi = in_ref[0, i]  # (bm, 128) uint32
-            for j in range(8):
-                ks = [int(consts[r, i, j]) for r in range(rows)]
-                if not any(ks):
-                    continue
-                bits = jax.lax.shift_right_logical(
-                    xi, jnp.uint32(j)) & jnp.uint32(0x01010101)
-                for r in range(rows):
-                    if ks[r] == 0:
-                        continue
-                    term = bits * jnp.uint32(ks[r])
-                    accs[r] = term if accs[r] is None else accs[r] ^ term
+        accs = _accumulate_bitplanes(consts, lambda i: in_ref[0, i])
         for r in range(rows):
             out_ref[0, r] = (accs[r] if accs[r] is not None
                              else jnp.zeros_like(in_ref[0, 0]))
