@@ -92,3 +92,26 @@ def test_pallas_interpret_reconstruct_coeff(rng):
     got = np.asarray(gf256_matmul_pallas(consts, stacked, block_bm=8,
                                          interpret=True))
     assert np.array_equal(got[0], shards[0])
+
+
+def test_stacked_transform_matches_oracle():
+    """gf256_stacked_transform: the (B, k, wm, 128) single-ref batch
+    kernel (the mesh path's workhorse) against the CPU oracle, including
+    a wm that forces the gcd block-size fallback."""
+    import jax
+    from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
+    from seaweedfs_tpu.ops.gf256_pallas import (gf256_stacked_transform,
+                                                u8_to_words, words_to_u8)
+
+    rng = np.random.default_rng(9)
+    cpu = CpuEncoder(use_native=False)
+    for b, n in ((1, 512), (3, 5 * 512), (2, 3 * 512)):
+        data = rng.integers(0, 256, (b, 10, n)).astype(np.uint8)
+        x = u8_to_words(jax.numpy.asarray(data))
+        out = words_to_u8(gf256_stacked_transform(
+            gf.bitplane_constants(gf.parity_matrix()), x, block_bm=2))
+        got = np.asarray(out)
+        for v in range(b):
+            want = cpu.encode(list(data[v]))[10:]
+            for p in range(4):
+                assert np.array_equal(got[v, p], want[p]), (b, n, v, p)
