@@ -6,6 +6,7 @@ weed/command/scaffold.go:337-361 (master.toml [master.maintenance]).
 """
 
 import asyncio
+import os
 
 from cluster_util import Cluster, run
 
@@ -91,3 +92,45 @@ def test_master_toml_parsing(tmp_path, monkeypatch):
                                     "ec.rebuild -force"]
     assert cfg["admin_scripts_interval_s"] == 180.0
     assert "sequencer" not in cfg  # memory = default, not forwarded
+
+
+def test_only_leader_runs_maintenance(tmp_path):
+    """In a multi-master cluster the maintenance loops are leader-gated:
+    followers wake up, see they are not leader, and do nothing — so a
+    vacuum never runs twice concurrently (topology_event_handling.go's
+    loop runs only on the elected master)."""
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    import asyncio
+
+    from test_election import _make_cluster, _wait_single_leader
+    from seaweedfs_tpu.shell import volume_commands as vc
+
+    async def body():
+        masters = await _make_cluster(2)
+        try:
+            leader = await _wait_single_leader(masters)
+            follower = next(m for m in masters if m is not leader)
+            # both loops configured hot; count volume_vacuum invocations
+            calls = []
+            orig = vc.volume_vacuum
+
+            async def counting(env, *a, **kw):
+                calls.append(env.master_url)
+                return []
+            vc.volume_vacuum = counting
+            try:
+                for m in masters:
+                    m.maintenance_interval_s = 0.2
+                    m._tasks.append(asyncio.create_task(
+                        m._auto_vacuum_loop()))
+                await asyncio.sleep(1.2)
+            finally:
+                vc.volume_vacuum = orig
+            assert calls, "leader never ran maintenance"
+            assert set(calls) == {leader.url}, (calls, leader.url,
+                                                follower.url)
+        finally:
+            for m in masters:
+                await m.stop()
+    run(body())
