@@ -77,8 +77,7 @@ def _transform_buffers_async(encoder, coeff: np.ndarray,
     file reads and transfers with this batch's kernel time (the reference
     overlaps nothing: its 256KB loop at ec_encoder.go:114-186 is serial).
     CPU encoders compute eagerly and the thunk is a no-op."""
-    from .encoder_jax import JaxEncoder
-    if isinstance(encoder, JaxEncoder):
+    if _use_overlap(encoder):  # the single async-dispatch predicate
         import os
 
         import jax
@@ -139,7 +138,25 @@ def _iter_row_batches(dat_size: int, large_block: int, small_block: int,
         remaining -= small_block * gf.DATA_SHARDS
 
 
-def _run_overlapped(read_batches, launch, write_result) -> None:
+def _use_overlap(encoder) -> bool:
+    """Thread-overlap pays only when launch() is genuinely asynchronous
+    (JAX dispatch returns before the device finishes). For host encoders
+    the transform is eager, so the threads just add queue hand-off and
+    GIL contention — measured 2x SLOWER on a single-core host — and the
+    plain serial loop wins.
+
+    This is THE async-dispatch predicate: _transform_buffers_async
+    branches on it too, so the pipeline shape and the launch semantics
+    cannot diverge."""
+    try:
+        from .encoder_jax import JaxEncoder
+    except ImportError:  # jax-less host: CPU encoders only, eager
+        return False
+    return isinstance(encoder, JaxEncoder)
+
+
+def _run_overlapped(read_batches, launch, write_result,
+                    overlap: bool = True) -> None:
     """Three-stage threaded pipeline: a reader thread fills a bounded
     queue of input batches, the caller thread launches the (async) device
     transform, and a writer thread blocks on readback + file writes.
@@ -152,7 +169,12 @@ def _run_overlapped(read_batches, launch, write_result) -> None:
     read_batches: generator yielding input batch objects.
     launch(batch) -> (batch, thunk) launched work.
     write_result(batch, thunk): called in writer-thread order.
+    overlap=False degrades to the serial loop (host encoders).
     """
+    if not overlap:
+        for batch in read_batches:
+            write_result(*launch(batch))
+        return
     q_read: queue.Queue = queue.Queue(maxsize=_PIPE_DEPTH)
     q_write: queue.Queue = queue.Queue(maxsize=_PIPE_DEPTH)
     errs: list[BaseException] = []
@@ -248,7 +270,8 @@ def write_ec_files(base_name: str, encoder=None,
             outs[gf.DATA_SHARDS + p].write(np.asarray(buf, np.uint8).tobytes())
 
     try:
-        _run_overlapped(batches(), launch, write_result)
+        _run_overlapped(batches(), launch, write_result,
+                        overlap=_use_overlap(encoder))
     finally:
         f.close()
         for o in outs:
@@ -414,7 +437,8 @@ def rebuild_ec_files(base_name: str, encoder=None,
             o.write(np.asarray(buf, np.uint8).tobytes())
 
     try:
-        _run_overlapped(batches(), launch, write_result)
+        _run_overlapped(batches(), launch, write_result,
+                        overlap=_use_overlap(encoder))
     finally:
         for f in ins:
             f.close()

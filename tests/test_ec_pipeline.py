@@ -218,9 +218,7 @@ def test_overlapped_pipeline_error_propagates(tmp_path):
     v.close()
     base = os.path.join(d, "7")
 
-    class ExplodingEncoder:
-        """Duck-typed encoder: neither Jax nor Cpu, so the pipeline uses
-        the numpy fallback — patched to throw on the 3rd batch."""
+    from seaweedfs_tpu.ec.encoder_jax import JaxEncoder
 
     calls = {"n": 0}
     from seaweedfs_tpu.ec import pipeline as plmod
@@ -230,13 +228,16 @@ def test_overlapped_pipeline_error_propagates(tmp_path):
         calls["n"] += 1
         if calls["n"] == 3:
             raise RuntimeError("kaboom")
-        return orig(encoder, coeff, buffers)
+        # stay off the device path under the fake: compute via numpy
+        return orig(object(), coeff, buffers)
 
     plmod._transform_buffers_async = exploding
     try:
         before = threading.active_count()
         with pytest.raises(RuntimeError, match="kaboom"):
-            pl.write_ec_files(base, encoder=ExplodingEncoder(),
+            # JaxEncoder selects the THREADED pipeline (_use_overlap),
+            # which is the error path under test
+            pl.write_ec_files(base, encoder=JaxEncoder(),
                               large_block=LB, small_block=SB,
                               buffer_size=SB)
         # pipeline threads joined, none leaked
