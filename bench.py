@@ -219,15 +219,21 @@ class ImplausibleResult(Exception):
 
 def _chained_gbs(transform, consts, words, n: int, chain_len: int,
                  rtt: float) -> tuple[float, float, int]:
-    """Sustained GB/s of data-shard bytes through the kernel, amortising
-    dispatch latency over chain_len dependent kernel invocations inside
-    one jit (outputs feed the next step's inputs, preventing CSE).
+    """Sustained GB/s of data-shard bytes through the kernel.
+
+    chain_len dependent kernel invocations run inside one jit (outputs
+    feed the next step's inputs, preventing CSE); several chain calls
+    are then DISPATCHED AHEAD and blocked on once, so the tunnel's
+    round-trip latency amortises across the whole timed region via JAX
+    async dispatch instead of being subtracted out.
 
     Measurement honesty rules (the round-3 verdict's #1):
-      * rtt is subtracted ONLY when the timed chain dwarfs it (dt > 10*rtt)
-        — never clamped; a chain too short to measure is GROWN, not faked.
+      * nothing is ever subtracted from a timing — any dispatch overhead
+        that async dispatch fails to hide is COUNTED, so the number can
+        only understate the kernel;
+      * a chain too short to measure is grown, not corrected;
       * any result above the HBM ceiling raises ImplausibleResult.
-    Returns (gbs, dt, chain_len actually used).
+    Returns (gbs, total timed seconds, chain_len actually used).
     """
     import jax
     import jax.numpy as jnp
@@ -246,33 +252,39 @@ def _chained_gbs(transform, consts, words, n: int, chain_len: int,
         return chain
 
     for _attempt in range(4):
-        measured_chain = chain_len  # dt below belongs to THIS length
-        chain = build(measured_chain)
-        float(chain(*words))  # compile
-        iters = 2
+        used_cl = chain_len  # the length the built chain ACTUALLY runs:
+        #                      every timing below divides by this, never
+        #                      by a post-growth value
+        chain = build(used_cl)
+        float(chain(*words))  # compile + warm
         t0 = time.perf_counter()
-        for _ in range(iters):
-            float(chain(*words))
-        dt = (time.perf_counter() - t0) / iters
-        # 5x rtt is enough to report honestly (no rtt subtraction below
-        # 10x); growing a slow path's chain just burns recompiles
-        if dt > 5 * rtt or chain_len >= 256:
+        float(chain(*words))
+        dt1 = time.perf_counter() - t0
+        if dt1 > 5 * rtt or used_cl >= 256:
             break
-        # chain too short to separate from dispatch latency: grow it so
-        # kernel time dominates instead of subtracting into the noise
-        grow = max(2, int(10 * rtt / max(dt, 1e-6)) + 1)
-        chain_len = min(256, chain_len * grow)
-        _log(f"  chain too short (dt={dt * 1e3:.0f}ms vs rtt="
+        # chain too short for one dispatch to dominate its own rtt:
+        # grow it (bounded) so the async loop below isn't dispatch-bound
+        grow = max(2, int(5 * rtt / max(dt1, 1e-6)) + 1)
+        chain_len = min(256, used_cl * grow)
+        _log(f"  chain too short (dt={dt1 * 1e3:.0f}ms vs rtt="
              f"{rtt * 1e3:.0f}ms); growing chain to {chain_len}")
-    chain_len = measured_chain
-    per_step = ((dt - rtt) if dt > 10 * rtt else dt) / chain_len
+    # dispatch-ahead: enough chain calls that the timed region spans
+    # >= ~10 rtts and ~1s of kernel time, blocking only on the last
+    iters = max(2, int(max(1.0, 10 * rtt) / max(dt1, 1e-6)) + 1)
+    t0 = time.perf_counter()
+    r = None
+    for _ in range(iters):
+        r = chain(*words)
+    float(r)  # single sync point
+    dt = time.perf_counter() - t0
+    per_step = dt / (iters * used_cl)
     gbs = k * n / per_step / 1e9
     if gbs > HBM_BOUND_GBPS:
         raise ImplausibleResult(
             f"{gbs:.0f} GB/s exceeds the {HBM_BOUND_GBPS:.0f} GB/s HBM "
-            f"ceiling (dt={dt * 1e3:.1f}ms chain={chain_len}) — "
-            f"measurement artifact, not reported")
-    return gbs, dt, chain_len
+            f"ceiling (dt={dt * 1e3:.1f}ms chain={used_cl} "
+            f"iters={iters}) — measurement artifact, not reported")
+    return gbs, dt, used_cl
 
 
 def child_main() -> None:
