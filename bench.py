@@ -287,9 +287,15 @@ def _chained_gbs(transform, consts, words, n: int, chain_len: int,
     # reported figure still divides the full measured dt.)
     est_step = max(dt1 - rtt, dt1 / 4, 1e-6)
     target = max(1.0, 10 * rtt)
+    iters = max(2, int(target / est_step) + 1)
     if budget_s is not None:
-        target = min(target, max(budget_s - spent(), 2 * est_step))
-    iters = min(max(2, int(target / est_step) + 1), 100_000)
+        # the budget cap must use the CONSERVATIVE blocking step time
+        # dt1, not est_step: with async dispatch the in-loop deadline
+        # below may never fire (dispatches return instantly) and the
+        # final sync blocks for iters * real_step
+        iters = min(iters,
+                    max(2, int(max(budget_s - spent(), 0.0) / dt1) + 1))
+    iters = min(iters, 100_000)
     t0 = time.perf_counter()
     r = None
     done = 0

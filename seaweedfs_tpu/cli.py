@@ -281,6 +281,25 @@ def _load_master_toml() -> dict:
     return {}
 
 
+async def _serve_until_interrupt(*servers) -> None:
+    """Run until SIGINT/SIGTERM/SIGHUP, then stop servers in order.
+
+    The graceful path (reference: weed/util/signal_handling.go:19-44 +
+    httpdown) — stop() commits needle maps / closes stores, and the
+    normal return lets atexit fire, which is what dumps
+    -cpuprofile/-memprofile output (util/pprof.py)."""
+    from .util import glog
+    from .util.signals import wait_for_interrupt
+    num = await wait_for_interrupt()
+    glog.V(1).infof("signal %s: shutting down %d server(s)",
+                    num, len(servers))
+    for srv in servers:
+        try:
+            await srv.stop()
+        except Exception as e:  # noqa: BLE001 — best-effort drain
+            glog.warning("shutdown of %s: %s", type(srv).__name__, e)
+
+
 async def _run_master(args) -> None:
     from .master.server import MasterServer
     toml_cfg = _load_master_toml()
@@ -306,7 +325,7 @@ async def _run_master(args) -> None:
         from .stats.metrics import push_loop
         asyncio.create_task(push_loop(args.metricsGateway, "master"))
     print(f"master listening on {m.url}")
-    await asyncio.Event().wait()
+    await _serve_until_interrupt(m)
 
 
 async def _run_volume(args) -> None:
@@ -337,7 +356,7 @@ async def _run_volume(args) -> None:
                       pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey)
     await vs.start()
     print(f"volume server listening on {vs.url}, dirs={dirs}")
-    await asyncio.Event().wait()
+    await _serve_until_interrupt(vs)
 
 
 def _store_kwargs(store: str, db_path: str) -> dict:
@@ -363,7 +382,7 @@ async def _run_filer(args) -> None:
                      replication=args.replication)
     await fs.start()
     print(f"filer listening on {fs.url} (store={args.store})")
-    await asyncio.Event().wait()
+    await _serve_until_interrupt(fs)
 
 
 def _make_queue(spec: str):
@@ -535,7 +554,7 @@ async def _run_s3(args) -> None:
                    ip=args.ip, port=args.port, identities=identities)
     await s3.start()
     print(f"s3 gateway listening on {s3.url}")
-    await asyncio.Event().wait()
+    await _serve_until_interrupt(s3)
 
 
 async def _run_webdav(args) -> None:
@@ -549,7 +568,7 @@ async def _run_webdav(args) -> None:
                       chunk_size=args.chunkSizeMB * 1024 * 1024)
     await wd.start()
     print(f"webdav listening on {wd.url} (store={args.store})")
-    await asyncio.Event().wait()
+    await _serve_until_interrupt(wd)
 
 
 async def _run_server(args) -> None:
@@ -570,6 +589,7 @@ async def _run_server(args) -> None:
     await vs.heartbeat_once()
     parts = [f"master={m.url}", f"volume={vs.url}"]
     filer_srv = None
+    s3 = None
     if args.filer or args.s3:
         filer_srv = FilerServer(
             Filer("sqlite", path=os.path.join(args.dir, "filer.db")),
@@ -581,7 +601,9 @@ async def _run_server(args) -> None:
         await s3.start()
         parts.append(f"s3={s3.url}")
     print("server up: " + " ".join(parts))
-    await asyncio.Event().wait()
+    # data plane drains before the control plane disappears
+    await _serve_until_interrupt(*[srv for srv in (s3, filer_srv, vs, m)
+                                   if srv is not None])
 
 
 async def _run_upload(args) -> None:
