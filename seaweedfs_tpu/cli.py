@@ -386,16 +386,11 @@ async def _run_filer(args) -> None:
 
 
 def _make_queue(spec: str):
-    from .notification.queues import FileQueue, LogQueue, SqliteQueue
-    if spec == "log":
-        return LogQueue()
-    kind, _, path = spec.partition(":")
-    if kind == "file" and path:
-        return FileQueue(path)
-    if kind == "sqlite" and path:
-        return SqliteQueue(path)
-    raise SystemExit(f"bad -notify spec {spec!r}; "
-                     f"use log | file:<path> | sqlite:<path>")
+    from .notification.queues import queue_from_spec
+    try:
+        return queue_from_spec(spec)
+    except ValueError as e:
+        raise SystemExit(str(e))
 
 
 def _make_subscription(spec: str):
@@ -646,28 +641,34 @@ async def _run_download(args) -> None:
 
 
 async def _run_shell(args) -> None:
-    from .shell.runner import run_command, HELP
+    from .shell.env import CommandEnv
+    from .shell.runner import dispatch, run_command, HELP
     if args.command:
         await run_command(args.master, args.command)
         return
     print("seaweedfs_tpu shell; 'help' for commands, 'exit' to quit")
     loop = asyncio.get_running_loop()
-    while True:
-        try:
-            line = await loop.run_in_executor(None, input, "> ")
-        except (EOFError, KeyboardInterrupt):
-            break
-        line = line.strip()
-        if line in ("exit", "quit"):
-            break
-        if line == "help":
-            print(HELP)
-            continue
-        if line:
+    # one env for the whole session so fs.cd working-directory state
+    # carries across commands (shell_liner.go keeps one CommandEnv)
+    async with CommandEnv(args.master) as env:
+        while True:
             try:
-                await run_command(args.master, line)
-            except Exception as e:
-                print(f"error: {e}")
+                line = await loop.run_in_executor(None, input, "> ")
+            except (EOFError, KeyboardInterrupt):
+                break
+            line = line.strip()
+            if line in ("exit", "quit"):
+                break
+            if line == "help":
+                print(HELP)
+                continue
+            if line:
+                try:
+                    res = await dispatch(env, line)
+                    if res is not None:
+                        print(json.dumps(res, indent=2, default=str))
+                except Exception as e:
+                    print(f"error: {e}")
 
 
 async def _run_benchmark(args) -> None:

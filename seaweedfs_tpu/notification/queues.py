@@ -154,6 +154,21 @@ MESSAGE_QUEUES: list[MessageQueue] = [
 ]
 
 
+def queue_from_spec(spec: str) -> MessageQueue:
+    """Build a local queue from a `log | file:<path> | sqlite:<path>`
+    CLI/shell spec (the -notify flag style shared by the filer command
+    and fs.meta.notify)."""
+    if spec == "log":
+        return LogQueue()
+    kind, _, path = spec.partition(":")
+    if kind == "file" and path:
+        return FileQueue(path)
+    if kind == "sqlite" and path:
+        return SqliteQueue(path)
+    raise ValueError(f"bad notify spec {spec!r}; "
+                     f"use log | file:<path> | sqlite:<path>")
+
+
 def load_configuration(config: dict | None) -> MessageQueue | None:
     """Pick the single enabled queue ([notification.<name>] enabled=true),
     mirroring configuration.go:24-58 incl. the exactly-one check."""

@@ -665,7 +665,12 @@ class VolumeServer:
         return web.json_response({"ok": True})
 
     async def h_volume_delete(self, req: web.Request) -> web.Response:
-        self.store.delete_volume(int(req.query["volume"]))
+        try:
+            self.store.delete_volume(int(req.query["volume"]),
+                                     req.query.get("collection", ""))
+        except VolumeError as e:
+            # a delete that found nothing must not report success
+            return web.json_response({"error": str(e)}, status=404)
         return web.json_response({"ok": True})
 
     async def h_readonly(self, req: web.Request) -> web.Response:

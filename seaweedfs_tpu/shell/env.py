@@ -17,6 +17,12 @@ class CommandEnv:
         self.master_url = master_url
         self._session = session
         self._own_session = session is None
+        # REPL working-directory state (fs.cd / fs.pwd,
+        # shell/command_fs_cd.go + command_fs_pwd.go): fs.* commands
+        # default their -filer/-path to these when a session reuses one
+        # env across commands
+        self.filer = ""
+        self.wd = "/"
 
     async def __aenter__(self) -> "CommandEnv":
         if self._session is None:

@@ -169,6 +169,57 @@ async def fs_meta_load(env: CommandEnv, filer: str, in_file: str) -> dict:
     return out
 
 
+async def fs_meta_cat(env: CommandEnv, filer: str, path: str) -> dict:
+    """Full stored metadata of one entry (command_fs_meta_cat.go)."""
+    async with env.http.get(_filer_url(filer, "/__api__/lookup"),
+                            params={"path": path}) as resp:
+        body = await resp.json()
+        if resp.status != 200:
+            raise ValueError(f"{path}: {body.get('error', 'lookup failed')}")
+        return body
+
+
+def _api_to_entry_dict(e: dict) -> dict:
+    """FilerServer._entry_json wire shape -> filer Entry.to_dict shape
+    (what EventNotification payloads carry, pb/filer.proto analog)."""
+    return {
+        "full_path": e["FullPath"],
+        "attr": {
+            "mtime": e.get("Mtime", 0), "crtime": e.get("Crtime", 0),
+            "mode": e.get("Mode", 0o660),
+            "uid": e.get("Uid", 0), "gid": e.get("Gid", 0),
+            "mime": e.get("Mime", ""),
+            "replication": e.get("Replication", ""),
+            "collection": e.get("Collection", ""),
+            "ttl_sec": e.get("TtlSec", 0),
+        },
+        "chunks": e.get("chunks", []),
+        "extended": e.get("extended", {}),
+    }
+
+
+async def fs_meta_notify(env: CommandEnv, filer: str, path: str,
+                         queue) -> dict:
+    """Re-publish create events for a whole subtree into a notification
+    queue, so a replicator can be primed with data that predates the
+    queue (command_fs_meta_notify.go). Events go through the same
+    event_of producer the live filer listeners use, so the wire shape
+    cannot drift from what Replicator consumes."""
+    from ..filer.entry import Entry
+    from ..notification.queues import event_of
+
+    dirs = files = 0
+    async for e, _ in _walk(env, filer, path):
+        entry = Entry.from_dict(_api_to_entry_dict(e))
+        queue.send_message(e["FullPath"],
+                           event_of(None, entry, delete_chunks=False))
+        if _is_dir(e):
+            dirs += 1
+        else:
+            files += 1
+    return {"notified_dirs": dirs, "notified_files": files}
+
+
 async def collection_list(env: CommandEnv) -> list[str]:
     body = await env.master_get("/vol/volumes")
     cols = set()

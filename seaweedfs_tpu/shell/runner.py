@@ -6,6 +6,7 @@ Reference: weed/shell/commands.go registry + shell_liner.go REPL.
 from __future__ import annotations
 
 import json
+import posixpath
 import shlex
 
 from . import ec_commands as ec
@@ -21,21 +22,39 @@ HELP = """commands:
   volume.vacuum          [-garbageThreshold 0.3] [-collection c]
   volume.fix.replication [-force]
   volume.balance         [-force]
-  volume.move  -volumeId n -source host:port -target host:port
+  volume.move   -volumeId n -source host:port -target host:port
+  volume.copy   -volumeId n -source host:port -target host:port
+  volume.mount   -volumeId n -node host:port [-collection c]
+  volume.unmount -volumeId n -node host:port
+  volume.delete  -volumeId n -node host:port [-collection c]
   volume.tier.upload   -volumeId n [-backend s3.default] [-keepLocal]
   volume.tier.download -volumeId n
   volume.list
   collection.list
   collection.delete -collection c
-  fs.ls   -filer host:port [-path /dir] [-l]
-  fs.cat  -filer host:port -path /f
-  fs.du   -filer host:port [-path /dir]
-  fs.tree -filer host:port [-path /dir]
-  fs.mv   -filer host:port -from /a -to /b
-  fs.rm   -filer host:port -path /f [-recursive]
-  fs.meta.save -filer host:port [-path /] [-o meta.jsonl]
-  fs.meta.load -filer host:port [-i meta.jsonl]
+  fs.cd   -filer host:port [-path /dir]   (sets session default filer+dir)
+  fs.pwd
+  fs.ls   [-filer host:port] [-path /dir] [-l]
+  fs.cat  [-filer host:port] -path /f
+  fs.du   [-filer host:port] [-path /dir]
+  fs.tree [-filer host:port] [-path /dir]
+  fs.mv   [-filer host:port] -from /a -to /b
+  fs.rm   [-filer host:port] -path /f [-recursive]
+  fs.meta.cat    [-filer host:port] -path /f
+  fs.meta.save   [-filer host:port] [-path /] [-o meta.jsonl]
+  fs.meta.load   [-filer host:port] [-i meta.jsonl]
+  fs.meta.notify [-filer host:port] [-path /] -notify file:<p>|sqlite:<p>|log
 """
+
+
+def _resolve_path(env: CommandEnv, p: str | None) -> str:
+    """Resolve an fs.* path against the session working directory
+    (fs.cd semantics, shell/command_fs_cd.go)."""
+    if not p:
+        return env.wd
+    if not p.startswith("/"):
+        p = posixpath.join(env.wd, p)
+    return posixpath.normpath(p)
 
 
 def _flags(tokens: list[str]) -> dict[str, str]:
@@ -108,6 +127,22 @@ async def dispatch(env: CommandEnv, line: str) -> object:
                              flags.get("collection", ""),
                              flags["source"], flags["target"])
         res = {"moved": flags["volumeId"]}
+    elif cmd == "volume.copy":
+        await vc.volume_copy(env, int(flags["volumeId"]),
+                             flags.get("collection", ""),
+                             flags["source"], flags["target"])
+        res = {"copied": flags["volumeId"], "to": flags["target"]}
+    elif cmd == "volume.mount":
+        res = await vc.volume_mount(env, int(flags["volumeId"]),
+                                    flags["node"],
+                                    flags.get("collection", ""))
+    elif cmd == "volume.unmount":
+        res = await vc.volume_unmount(env, int(flags["volumeId"]),
+                                      flags["node"])
+    elif cmd == "volume.delete":
+        res = await vc.volume_delete(env, int(flags["volumeId"]),
+                                     flags["node"],
+                                     flags.get("collection", ""))
     elif cmd == "volume.tier.upload":
         res = await vc.volume_tier_upload(
             env, int(flags["volumeId"]),
@@ -122,10 +157,21 @@ async def dispatch(env: CommandEnv, line: str) -> object:
     elif cmd == "collection.delete":
         res = await fs.collection_delete(env, flags["collection"])
     elif cmd.startswith("fs."):
-        filer = flags.get("filer", "")
+        filer = flags.get("filer", "") or env.filer
+        if cmd == "fs.pwd":
+            return {"filer": filer, "cwd": env.wd}
         if not filer:
-            raise ValueError("fs.* commands need -filer host:port")
-        path = flags.get("path", "/")
+            raise ValueError(
+                "fs.* commands need -filer host:port (or a prior fs.cd)")
+        path = _resolve_path(env, flags.get("path"))
+        if cmd == "fs.cd":
+            if path != "/":
+                # validate before committing the session default
+                meta = await fs.fs_meta_cat(env, filer, path)
+                if not meta.get("IsDirectory"):
+                    raise ValueError(f"{path} is not a directory")
+            env.filer, env.wd = filer, path
+            return {"filer": filer, "cwd": path}
         if cmd == "fs.ls":
             res = await fs.fs_ls(env, filer, path,
                                  long_format=flags.get("l") == "true")
@@ -139,15 +185,31 @@ async def dispatch(env: CommandEnv, line: str) -> object:
             print(await fs.fs_tree(env, filer, path))
             return None
         elif cmd == "fs.mv":
-            res = await fs.fs_mv(env, filer, flags["from"],
-                                 flags["to"])
+            res = await fs.fs_mv(env, filer,
+                                 _resolve_path(env, flags["from"]),
+                                 _resolve_path(env, flags["to"]))
         elif cmd == "fs.rm":
             if "path" not in flags:
                 # never let a forgotten -path default to deleting "/"
                 raise ValueError("fs.rm requires an explicit -path")
-            res = await fs.fs_rm(env, filer, flags["path"],
+            res = await fs.fs_rm(env, filer,
+                                 _resolve_path(env, flags["path"]),
                                  recursive=flags.get(
                                      "recursive") == "true")
+        elif cmd == "fs.meta.cat":
+            if "path" not in flags:
+                raise ValueError("fs.meta.cat requires -path")
+            res = await fs.fs_meta_cat(env, filer, path)
+        elif cmd == "fs.meta.notify":
+            from ..notification.queues import queue_from_spec
+            if "notify" not in flags:
+                raise ValueError("fs.meta.notify requires "
+                                 "-notify file:<p>|sqlite:<p>|log")
+            queue = queue_from_spec(flags["notify"])
+            try:
+                res = await fs.fs_meta_notify(env, filer, path, queue)
+            finally:
+                queue.close()
         elif cmd == "fs.meta.save":
             res = await fs.fs_meta_save(env, filer, path,
                                         flags.get("o", "meta.jsonl"))

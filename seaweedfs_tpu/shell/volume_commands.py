@@ -125,15 +125,46 @@ async def volume_balance(env: CommandEnv,
     return moves
 
 
+async def volume_copy(env: CommandEnv, vid: int, collection: str,
+                      src: str, dst: str) -> dict:
+    """Copy a volume to another node, source kept
+    (command_volume_copy.go)."""
+    return await env.node_post(dst, "/admin/volume/copy", volume=str(vid),
+                               collection=collection, source=src)
+
+
 async def volume_move(env: CommandEnv, vid: int, collection: str,
                       src: str, dst: str) -> None:
     """copy to dst + mount, then unmount + delete on src
     (command_volume_move.go)."""
-    await env.node_post(dst, "/admin/volume/copy", volume=str(vid),
-                        collection=collection, source=src)
+    await volume_copy(env, vid, collection, src, dst)
     # delete while still mounted so the store destroys the on-disk files
     # (unmount-then-delete would leave .dat/.idx to resurrect on restart)
     await env.node_post(src, "/admin/volume/delete", volume=str(vid))
+
+
+async def volume_mount(env: CommandEnv, vid: int, node: str,
+                       collection: str = "") -> dict:
+    """Mount a volume already on the node's disk
+    (command_volume_mount.go). The collection names the on-disk file
+    (<collection>_<vid>.dat), so it must travel with the request."""
+    return await env.node_post(node, "/admin/volume/mount",
+                               volume=str(vid), collection=collection)
+
+
+async def volume_unmount(env: CommandEnv, vid: int, node: str) -> dict:
+    """Unmount a volume, keeping its files on disk
+    (command_volume_unmount.go)."""
+    return await env.node_post(node, "/admin/volume/unmount",
+                               volume=str(vid))
+
+
+async def volume_delete(env: CommandEnv, vid: int, node: str,
+                        collection: str = "") -> dict:
+    """Delete a volume from a node, destroying its files — including an
+    unmounted volume's (command_volume_delete.go)."""
+    return await env.node_post(node, "/admin/volume/delete",
+                               volume=str(vid), collection=collection)
 
 
 async def volume_tier_upload(env: CommandEnv, vid: int,

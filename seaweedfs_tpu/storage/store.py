@@ -141,13 +141,34 @@ class Store:
             self.new_volumes.append(self._volume_message(v))
             return v
 
-    def delete_volume(self, vid: int) -> None:
+    def delete_volume(self, vid: int, collection: str = "") -> None:
         with self._lock:
             v = self.volumes.pop(vid, None)
             if v is not None:
                 msg = self._volume_message(v)
                 v.destroy()
                 self.deleted_volumes.append(msg)
+                return
+            # not mounted: still destroy the on-disk files — an unmount
+            # followed by delete must not leave .dat/.idx behind to
+            # resurrect the volume on the next mount or restart
+            for d in self.dirs:
+                base = os.path.join(
+                    d, f"{collection}_{vid}" if collection else str(vid))
+                if not os.path.exists(base + ".dat"):
+                    continue
+                try:
+                    Volume(d, collection, vid, create_if_missing=False,
+                           needle_map_kind=self.index_type).destroy()
+                except Exception:  # noqa: BLE001 — damaged volume: the
+                    # load path may refuse it, but delete must still win
+                    for ext in (".dat", ".idx", ".vif", ".sdx",
+                                ".cpd", ".cpx"):
+                        p = base + ext
+                        if os.path.exists(p):
+                            os.remove(p)
+                return
+        raise VolumeError(f"volume {vid} not found")
 
     def mark_readonly(self, vid: int, read_only: bool = True) -> None:
         with self._lock:
