@@ -108,13 +108,19 @@ class MasterServer:
         app.router.add_get("/stats/health", self.h_health)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_route("*", "/vol/grow", self.h_grow)
+        app.router.add_route("*", "/vol/vacuum", self.h_vacuum)
         app.router.add_route("*", "/col/delete", self.h_collection_delete)
         app.router.add_get("/vol/volumes", self.h_volumes)
+        app.router.add_get("/vol/status", self.h_volumes)
         app.router.add_get("/vol/ec_lookup", self.h_ec_lookup)
+        app.router.add_route("*", "/submit", self.h_submit)
         app.router.add_post("/raft/vote", self.h_raft_vote)
         app.router.add_post("/raft/heartbeat", self.h_raft_heartbeat)
         app.router.add_get("/ui", self.h_ui)
         app.router.add_get("/", self.h_ui)
+        # catch-all LAST: GET /<fid> redirects to a holder of the volume
+        # (master_server.go:121 redirectHandler)
+        app.router.add_get("/{fid}", self.h_fid_redirect)
         return app
 
     @property
@@ -215,10 +221,15 @@ class MasterServer:
             return web.json_response(
                 {"error": "no leader elected yet"}, status=503)
         data = await req.read()
+        # forward Content-Type: /submit interprets its body by it
+        # (multipart vs raw), and dropping it would corrupt the upload
+        headers = {}
+        if "Content-Type" in req.headers:
+            headers["Content-Type"] = req.headers["Content-Type"]
         try:
             async with self._http.request(
                     req.method, tls.url(leader, f"{req.path_qs}"),
-                    data=data or None) as resp:
+                    data=data or None, headers=headers) as resp:
                 return web.Response(body=await resp.read(),
                                     status=resp.status,
                                     content_type=resp.content_type)
@@ -363,6 +374,91 @@ class MasterServer:
             "locations": [{"url": n.url, "publicUrl": n.public_url}
                           for n in nodes],
         })
+
+    async def h_vacuum(self, req: web.Request) -> web.Response:
+        """HTTP vacuum trigger (master_server.go:116 volumeVacuumHandler):
+        the manual form of the auto-vacuum loop, same underlying
+        check -> compact -> commit workflow."""
+        if not self.is_leader:
+            return await self._proxy_to_leader(req)
+        from ..shell import volume_commands as vc
+        from ..shell.env import CommandEnv
+        try:
+            threshold = float(req.query.get("garbageThreshold",
+                                            self.garbage_threshold))
+        except ValueError:
+            return web.json_response(
+                {"error": "bad garbageThreshold"}, status=400)
+        async with CommandEnv(self.url, session=self._http) as env:
+            res = await vc.volume_vacuum(env, threshold)
+        return web.json_response({"vacuumed": res})
+
+    async def h_submit(self, req: web.Request) -> web.Response:
+        """One-shot upload through the master: assign + store
+        (master_server_handlers.go:117 submitFromMasterServerHandler,
+        operation.SubmitFiles)."""
+        if not self.is_leader:
+            return await self._proxy_to_leader(req)
+        from ..util.client import OperationError, WeedClient
+        name = ""
+        mime = ""
+        ctype = req.headers.get("Content-Type", "")
+        data = b""
+        if ctype.startswith("multipart/form-data"):
+            mp = await req.multipart()
+            async for part in mp:
+                if part.filename or part.name in ("file", None):
+                    name = part.filename or ""
+                    pct = part.headers.get("Content-Type", "")
+                    if pct and pct != "application/octet-stream":
+                        mime = pct
+                    data = await part.read()
+                    break
+        else:
+            data = await req.read()
+            if ctype and ctype != "application/octet-stream":
+                mime = ctype.split(";")[0]
+        if not data:
+            return web.json_response({"error": "no file content"},
+                                     status=400)
+        q = req.query
+        try:
+            async with WeedClient(self.url, session=self._http,
+                                  jwt_key=self.jwt_key) as c:
+                a = await c.assign(collection=q.get("collection", ""),
+                                   replication=q.get("replication", ""),
+                                   ttl=q.get("ttl", ""))
+                if "fid" not in a:
+                    return web.json_response(a, status=500)
+                await c.upload(a["fid"], a["url"], data, mime=mime,
+                               ttl=q.get("ttl", ""), auth=a.get("auth", ""))
+        except OperationError as e:
+            return web.json_response({"error": str(e)}, status=500)
+        return web.json_response({
+            "fid": a["fid"],
+            "fileUrl": f"{a.get('publicUrl') or a['url']}/{a['fid']}",
+            "fileName": name, "size": len(data)})
+
+    async def h_fid_redirect(self, req: web.Request) -> web.Response:
+        """GET /<fid>: redirect to a volume server holding the volume
+        (master_server.go:121 redirectHandler)."""
+        if not self.is_leader:
+            # topology is heartbeat-fed on the leader only
+            return await self._proxy_to_leader(req)
+        fid = req.match_info["fid"]
+        vid_s = fid.split(",")[0]
+        try:
+            vid = int(vid_s)
+        except ValueError:
+            return web.json_response({"error": f"bad fileId {fid!r}"},
+                                     status=404)
+        nodes = self.topo.lookup(vid)
+        if not nodes:
+            return web.json_response(
+                {"error": f"volume {vid} not found"}, status=404)
+        loc = nodes[hash(fid) % len(nodes)]
+        raise web.HTTPMovedPermanently(
+            location=f"http://{loc.public_url or loc.url}/{fid}")
 
     async def h_dir_status(self, req: web.Request) -> web.Response:
         dcs = []

@@ -155,7 +155,11 @@ class Store:
             for d in self.dirs:
                 base = os.path.join(
                     d, f"{collection}_{vid}" if collection else str(vid))
-                if not os.path.exists(base + ".dat"):
+                # a tiered volume without keepLocal has no .dat — its
+                # .vif/.idx still resurrect it on restart, so any sidecar
+                # marks the volume as present for deletion
+                if not any(os.path.exists(base + ext)
+                           for ext in (".dat", ".vif", ".idx")):
                     continue
                 try:
                     Volume(d, collection, vid, create_if_missing=False,
