@@ -192,6 +192,18 @@ def build_parser() -> argparse.ArgumentParser:
     bm.add_argument("-size", type=int, default=1024)
     bm.add_argument("-c", dest="concurrency", type=int, default=16)
     bm.add_argument("-collection", default="benchmark")
+    bm.add_argument("-replication", default="000")
+    bm.add_argument("-write", default="true", choices=("true", "false"),
+                    help="enable the write phase")
+    bm.add_argument("-read", default="true", choices=("true", "false"),
+                    help="enable the read phase")
+    bm.add_argument("-deletePercent", type=int, default=0,
+                    help="percent of writes immediately deleted again")
+    bm.add_argument("-list", dest="idList", default="",
+                    help="file of uploaded fids (written after the write "
+                         "phase; read phase loads it when -write=false)")
+    bm.add_argument("-readSequentially", action="store_true",
+                    help="read fids in list order instead of shuffled")
 
     bk = sub.add_parser("backup", help="incrementally back up one volume "
                                        "from a volume server to a local dir")
@@ -683,46 +695,83 @@ async def _run_benchmark(args) -> None:
     write_lat: list[float] = []
     read_lat: list[float] = []
     fids: list[str] = []
+    deletes = 0
+    do_write = args.write == "true"
+    do_read = args.read == "true"
+    if not do_write:
+        if not args.idList:
+            raise SystemExit("-write=false needs -list <fid file> "
+                             "from an earlier write run")
+        with open(args.idList) as f:
+            fids = [ln.strip() for ln in f if ln.strip()]
 
     async with WeedClient(args.master) as c:
         sem = asyncio.Semaphore(args.concurrency)
 
         async def write_one(i: int):
+            nonlocal deletes
             async with sem:
                 t0 = time.perf_counter()
                 fid = await c.upload_data(payload,
-                                          collection=args.collection)
+                                          collection=args.collection,
+                                          replication=args.replication)
+                # sample BEFORE any delete: the write percentiles must
+                # measure writes, not write+delete round trips
                 write_lat.append(time.perf_counter() - t0)
-                fids.append(fid)
+                if args.deletePercent > 0 and \
+                        i % 100 < args.deletePercent:
+                    await c.delete_fids([fid])
+                    deletes += 1
+                else:
+                    fids.append(fid)
 
-        t0 = time.perf_counter()
-        await asyncio.gather(*(write_one(i) for i in range(args.n)))
-        wdt = time.perf_counter() - t0
+        wdt = 0.0
+        if do_write:
+            t0 = time.perf_counter()
+            await asyncio.gather(*(write_one(i) for i in range(args.n)))
+            wdt = time.perf_counter() - t0
+            if args.idList:
+                with open(args.idList, "w") as f:
+                    f.write("\n".join(fids) + "\n")
+
+        read_bytes = 0
 
         async def read_one(fid: str):
+            nonlocal read_bytes
             async with sem:
                 t0 = time.perf_counter()
-                await c.read(fid)
+                data = await c.read(fid)
                 read_lat.append(time.perf_counter() - t0)
+                read_bytes += len(data)
 
-        t0 = time.perf_counter()
-        await asyncio.gather(*(read_one(f) for f in fids))
-        rdt = time.perf_counter() - t0
+        rdt = 0.0
+        if do_read and fids:
+            order = list(fids)
+            if not args.readSequentially:
+                rng.shuffle(order)
+            t0 = time.perf_counter()
+            await asyncio.gather(*(read_one(f) for f in order))
+            rdt = time.perf_counter() - t0
 
     def pct(xs, p):
         xs = sorted(xs)
         return xs[min(len(xs) - 1, int(p / 100 * len(xs)))] * 1e3
 
-    print(f"write: {args.n / wdt:.1f} req/s, "
-          f"{args.n * args.size / wdt / 1024:.1f} KB/s")
-    print(f"  latency ms p50/p95/p99/max: {pct(write_lat, 50):.1f}/"
-          f"{pct(write_lat, 95):.1f}/{pct(write_lat, 99):.1f}/"
-          f"{max(write_lat) * 1e3:.1f}")
-    print(f"read:  {len(fids) / rdt:.1f} req/s, "
-          f"{len(fids) * args.size / rdt / 1024:.1f} KB/s")
-    print(f"  latency ms p50/p95/p99/max: {pct(read_lat, 50):.1f}/"
-          f"{pct(read_lat, 95):.1f}/{pct(read_lat, 99):.1f}/"
-          f"{max(read_lat) * 1e3:.1f}")
+    if do_write:
+        print(f"write: {args.n / wdt:.1f} req/s, "
+              f"{args.n * args.size / wdt / 1024:.1f} KB/s"
+              + (f" ({deletes} deletes)" if deletes else ""))
+        print(f"  latency ms p50/p95/p99/max: {pct(write_lat, 50):.1f}/"
+              f"{pct(write_lat, 95):.1f}/{pct(write_lat, 99):.1f}/"
+              f"{max(write_lat) * 1e3:.1f}")
+    if do_read and fids:
+        # measured bytes, not -size: a -write=false run may read fids
+        # written with a different size
+        print(f"read:  {len(fids) / rdt:.1f} req/s, "
+              f"{read_bytes / rdt / 1024:.1f} KB/s")
+        print(f"  latency ms p50/p95/p99/max: {pct(read_lat, 50):.1f}/"
+              f"{pct(read_lat, 95):.1f}/{pct(read_lat, 99):.1f}/"
+              f"{max(read_lat) * 1e3:.1f}")
 
 
 async def _run_backup(args) -> None:

@@ -432,7 +432,10 @@ class MasterServer:
                     return web.json_response(a, status=500)
                 await c.upload(a["fid"], a["url"], data, mime=mime,
                                ttl=q.get("ttl", ""), auth=a.get("auth", ""))
-        except OperationError as e:
+        except (OperationError, aiohttp.ClientError,
+                asyncio.TimeoutError, OSError) as e:
+            # keep the JSON error contract even for connection-level
+            # failures between assign and upload
             return web.json_response({"error": str(e)}, status=500)
         return web.json_response({
             "fid": a["fid"],
@@ -443,8 +446,15 @@ class MasterServer:
         """GET /<fid>: redirect to a volume server holding the volume
         (master_server.go:121 redirectHandler)."""
         if not self.is_leader:
-            # topology is heartbeat-fed on the leader only
-            return await self._proxy_to_leader(req)
+            # topology is heartbeat-fed on the leader only; bounce the
+            # CLIENT there (proxying would buffer whole blobs in this
+            # process and swallow the leader's redirect)
+            leader = self.leader_url
+            if not leader or leader == self.url:
+                return web.json_response(
+                    {"error": "no leader elected yet"}, status=503)
+            raise web.HTTPFound(
+                location=tls.url(leader, f"/{req.match_info['fid']}"))
         fid = req.match_info["fid"]
         vid_s = fid.split(",")[0]
         try:
@@ -458,7 +468,7 @@ class MasterServer:
                 {"error": f"volume {vid} not found"}, status=404)
         loc = nodes[hash(fid) % len(nodes)]
         raise web.HTTPMovedPermanently(
-            location=f"http://{loc.public_url or loc.url}/{fid}")
+            location=tls.url(loc.public_url or loc.url, f"/{fid}"))
 
     async def h_dir_status(self, req: web.Request) -> web.Response:
         dcs = []
