@@ -202,7 +202,8 @@ def build_parser() -> argparse.ArgumentParser:
     bm.add_argument("-list", dest="idList", default="",
                     help="file of uploaded fids (written after the write "
                          "phase; read phase loads it when -write=false)")
-    bm.add_argument("-readSequentially", action="store_true",
+    bm.add_argument("-readSequentially", nargs="?", const="true",
+                    default="false", choices=("true", "false"),
                     help="read fids in list order instead of shuffled")
 
     bk = sub.add_parser("backup", help="incrementally back up one volume "
@@ -718,8 +719,11 @@ async def _run_benchmark(args) -> None:
                 # sample BEFORE any delete: the write percentiles must
                 # measure writes, not write+delete round trips
                 write_lat.append(time.perf_counter() - t0)
+                # random sampling like the reference (rand.Intn(100)):
+                # a modulo scheme front-loads deletes and skews the rate
+                # whenever n is not a multiple of 100
                 if args.deletePercent > 0 and \
-                        i % 100 < args.deletePercent:
+                        rng.randrange(100) < args.deletePercent:
                     await c.delete_fids([fid])
                     deletes += 1
                 else:
@@ -747,7 +751,7 @@ async def _run_benchmark(args) -> None:
         rdt = 0.0
         if do_read and fids:
             order = list(fids)
-            if not args.readSequentially:
+            if args.readSequentially != "true":
                 rng.shuffle(order)
             t0 = time.perf_counter()
             await asyncio.gather(*(read_one(f) for f in order))

@@ -213,13 +213,21 @@ class MasterServer:
             int(body["term"]), body["leader"],
             int(body.get("max_volume_id", 0))))
 
+    def _leader_or_503(self) -> tuple[str | None, web.Response | None]:
+        """Resolve the current leader, or the 503 every non-leader
+        entry point returns while no leader is elected."""
+        leader = self.leader_url
+        if not leader or leader == self.url:
+            return None, web.json_response(
+                {"error": "no leader elected yet"}, status=503)
+        return leader, None
+
     async def _proxy_to_leader(self, req: web.Request) -> web.Response:
         """Non-leader HTTP forwards to the leader
         (proxyToLeader, master_server.go:153-185)."""
-        leader = self.leader_url
-        if not leader or leader == self.url:
-            return web.json_response(
-                {"error": "no leader elected yet"}, status=503)
+        leader, err = self._leader_or_503()
+        if err is not None:
+            return err
         data = await req.read()
         # forward Content-Type: /submit interprets its body by it
         # (multipart vs raw), and dropping it would corrupt the upload
@@ -449,10 +457,9 @@ class MasterServer:
             # topology is heartbeat-fed on the leader only; bounce the
             # CLIENT there (proxying would buffer whole blobs in this
             # process and swallow the leader's redirect)
-            leader = self.leader_url
-            if not leader or leader == self.url:
-                return web.json_response(
-                    {"error": "no leader elected yet"}, status=503)
+            leader, err = self._leader_or_503()
+            if err is not None:
+                return err
             raise web.HTTPFound(
                 location=tls.url(leader, f"/{req.match_info['fid']}"))
         fid = req.match_info["fid"]

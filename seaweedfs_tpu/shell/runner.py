@@ -44,6 +44,8 @@ HELP = """commands:
   fs.meta.save   [-filer host:port] [-path /] [-o meta.jsonl]
   fs.meta.load   [-filer host:port] [-i meta.jsonl]
   fs.meta.notify [-filer host:port] [-path /] -notify file:<p>|sqlite:<p>|log
+fs.* also accept the path positionally (fs.ls /dir) and resolve relative
+paths against the fs.cd working directory.
 """
 
 
@@ -57,25 +59,45 @@ def _resolve_path(env: CommandEnv, p: str | None) -> str:
     return posixpath.normpath(p)
 
 
-def _flags(tokens: list[str]) -> dict[str, str]:
+# flags that never take a free-form value: a following bare token is the
+# positional path, not the flag's value (`fs.rm -recursive /f` must not
+# parse as recursive="/f"); an explicit true/false is still honored
+_BOOL_FLAGS = {"force", "keepLocal", "l", "recursive"}
+
+
+def _flags(tokens: list[str]) -> tuple[dict[str, str], list[str]]:
+    """Returns (flags, positionals). A bare token not consumed as a flag
+    value is positional — the reference's fs.* commands take their path
+    that way (`fs.ls /dir`, commandEnv.parseUrl)."""
     out = {}
+    pos = []
     i = 0
     while i < len(tokens):
         tok = tokens[i]
         if tok.startswith("-"):
+            key = tok.lstrip("-")
+            nxt = tokens[i + 1] if i + 1 < len(tokens) else "-"
             if "=" in tok:  # -fullPercent=95 (reference admin-script style)
-                key, _, val = tok.lstrip("-").partition("=")
+                key, _, val = key.partition("=")
                 out[key] = val
                 i += 1
-            elif i + 1 < len(tokens) and not tokens[i + 1].startswith("-"):
-                out[tok.lstrip("-")] = tokens[i + 1]
+            elif key in _BOOL_FLAGS:
+                if nxt in ("true", "false"):
+                    out[key] = nxt
+                    i += 2
+                else:
+                    out[key] = "true"
+                    i += 1
+            elif not nxt.startswith("-"):
+                out[key] = nxt
                 i += 2
             else:
-                out[tok.lstrip("-")] = "true"
+                out[key] = "true"
                 i += 1
         else:
+            pos.append(tok)
             i += 1
-    return out
+    return out, pos
 
 
 async def run_command(master_url: str, line: str) -> object:
@@ -94,7 +116,11 @@ async def dispatch(env: CommandEnv, line: str) -> object:
     tokens = shlex.split(line)
     if not tokens:
         return None
-    cmd, flags = tokens[0], _flags(tokens[1:])
+    cmd, (flags, positional) = tokens[0], _flags(tokens[1:])
+    if positional and cmd.startswith("fs.") and "path" not in flags:
+        # reference style: `fs.ls /dir`, `fs.cd /x` (fs.mv keeps its
+        # explicit -from/-to; a positional never silently becomes one)
+        flags["path"] = positional[0]
     if cmd == "ec.encode":
         vids = [int(flags["volumeId"])] if "volumeId" in flags else None
         res = await ec.ec_encode(

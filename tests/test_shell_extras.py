@@ -47,9 +47,11 @@ def test_fs_cd_pwd_meta_cat_notify(tmp_path):
                 assert meta["FullPath"] == "/docs/a.txt"
                 assert meta["chunks"] and not meta["IsDirectory"]
 
-                # relative cd + normalisation
-                res = await dispatch(env, "fs.cd -path sub")
+                # relative cd + normalisation, reference positional style
+                res = await dispatch(env, "fs.cd sub")
                 assert res["cwd"] == "/docs/sub"
+                assert set(await dispatch(env, "fs.ls /docs")) == \
+                    {"a.txt", "sub/"}
                 meta = await dispatch(env, "fs.meta.cat -path ../a.txt")
                 assert meta["FullPath"] == "/docs/a.txt"
 
