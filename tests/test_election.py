@@ -105,6 +105,28 @@ async def _body_proxy(tmp_path):
                 body = await resp.json()
             assert resp.status == 200, body
             assert "fid" in body, body
+
+            # /submit through a follower: the proxy must preserve the
+            # multipart Content-Type or the envelope gets stored raw
+            form = aiohttp.FormData()
+            form.add_field("file", b"via-follower", filename="f.bin")
+            async with http.post(f"http://{follower.url}/submit",
+                                 data=form) as resp:
+                sub = await resp.json()
+                assert resp.status == 200, sub
+            assert sub["size"] == 12 and sub["fileName"] == "f.bin"
+
+            # follower GET /<fid> bounces the client to the leader
+            # (302) rather than proxy-buffering the blob...
+            async with http.get(f"http://{follower.url}/{sub['fid']}",
+                                allow_redirects=False) as resp:
+                assert resp.status == 302
+                assert leader.url in resp.headers["Location"]
+            # ...and following the chain serves the exact bytes
+            async with http.get(
+                    f"http://{follower.url}/{sub['fid']}") as resp:
+                assert resp.status == 200
+                assert await resp.read() == b"via-follower"
     finally:
         if vs:
             await vs.stop()
