@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import gzip
+import json
 import os
 import time
 
@@ -24,7 +25,8 @@ from ..pb import messages as pb
 from ..util import glog
 from ..storage import types as t
 from ..storage.needle import (FLAG_GZIP, FLAG_HAS_LAST_MODIFIED,
-                              FLAG_IS_CHUNK_MANIFEST, CrcMismatch, Needle)
+                              FLAG_IS_CHUNK_MANIFEST, CrcMismatch, Needle,
+                              NeedleError)
 from ..storage.backend import BackendError
 from ..storage.store import Store
 from ..storage.volume import AlreadyDeleted, NotFound, VolumeError
@@ -287,19 +289,56 @@ class VolumeServer:
                 metrics.VOLUME_REQUEST_COUNTER.labels("read", "error").inc()
             return web.json_response({"error": str(e)}, status=503)
         headers = {"Etag": f'"{n.etag()}"', "Accept-Ranges": "bytes"}
+        if n.pairs:
+            # stored pairs come back as response headers
+            # (volume_server_handlers_read.go:123-132)
+            try:
+                pair_map = json.loads(n.pairs)
+                if isinstance(pair_map, dict):
+                    headers.update(
+                        {k: str(v) for k, v in pair_map.items()})
+                else:
+                    glog.warning("pairs of %s: not a JSON object",
+                                 req.match_info["fid"])
+            except ValueError:
+                glog.warning("unmarshal pairs of %s: bad json",
+                             req.match_info["fid"])
+        # conditional checks come BEFORE the chunked-manifest branch, as
+        # in the reference (read.go:102-121 precede tryHandleChunkedFile)
+        # — large assembled files are where a 304 saves the most
+        if n.last_modified:
+            headers["Last-Modified"] = time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
+            ims = req.headers.get("If-Modified-Since", "")
+            if ims:
+                import calendar
+                try:
+                    # calendar.timegm, NOT mktime: the header is GMT and
+                    # mktime applies the host zone (DST included)
+                    t = calendar.timegm(time.strptime(
+                        ims, "%a, %d %b %Y %H:%M:%S GMT"))
+                    if t >= int(n.last_modified):
+                        return web.Response(status=304, headers=headers)
+                except ValueError:
+                    pass  # unparseable date: serve normally (ref parity)
+        # conditional read (volume_server_handlers_read.go:113-116)
+        if req.headers.get("If-None-Match", "") == f'"{n.etag()}"':
+            return web.Response(status=304, headers=headers)
+        if req.headers.get("ETag-MD5") == "True":
+            # client asked for a content-MD5 etag instead of the CRC one
+            # (volume_server_handlers_read.go:117-121)
+            import hashlib
+            headers["Etag"] = f'"{hashlib.md5(n.data).hexdigest()}"'
         body = n.data
         if n.is_chunked_manifest and req.query.get("cm") != "false":
             # resolve the manifest into the assembled file
             # (tryHandleChunkedFile, volume_server_handlers_read.go:170)
-            return await self._serve_chunked_file(req, n)
+            return await self._serve_chunked_file(req, n, headers)
         if n.is_gzipped:
             if "gzip" in req.headers.get("Accept-Encoding", ""):
                 headers["Content-Encoding"] = "gzip"
             else:
                 body = gzip.decompress(body)
-        if n.last_modified:
-            headers["Last-Modified"] = time.strftime(
-                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
         ct = n.mime.decode() if n.mime else "application/octet-stream"
         # on-read image resize (volume_server_handlers_read.go:211-227)
         if ("width" in req.query or "height" in req.query) \
@@ -353,8 +392,9 @@ class VolumeServer:
         self._wclient.master_url = self.master_url
         return self._wclient
 
-    async def _serve_chunked_file(self, req: web.Request,
-                                  n: Needle) -> web.StreamResponse:
+    async def _serve_chunked_file(self, req: web.Request, n: Needle,
+                                  extra_headers: dict | None = None
+                                  ) -> web.StreamResponse:
         """tryHandleChunkedFile (volume_server_handlers_read.go:170-199):
         the needle body is a ChunkManifest; stream the assembled bytes,
         honoring Range so large files never fully buffer."""
@@ -367,6 +407,9 @@ class VolumeServer:
             return web.json_response(
                 {"error": f"bad chunk manifest: {e}"}, status=500)
         headers = {"Accept-Ranges": "bytes", "Etag": f'"{n.etag()}"'}
+        if extra_headers:
+            # pairs + Last-Modified computed by h_get ride along
+            headers.update(extra_headers)
         ct = cm.mime or (n.mime.decode() if n.mime
                          else "application/octet-stream")
         if cm.name:
@@ -426,8 +469,16 @@ class VolumeServer:
             # bake EXIF rotation into stored bytes (needle.go ParseUpload)
             from ..images import fix_jpeg_orientation
             data = fix_jpeg_orientation(data)
+        # Seaweed-* request headers ride along as needle pairs
+        # (needle.go:19,55-60 PairNamePrefix). Matched case-insensitively
+        # and stored canonicalized — Go's net/http canonicalizes header
+        # casing before the prefix check, so 'seaweed-owner' must count
+        pair_map = {k.title(): v for k, v in req.headers.items()
+                    if k.title().startswith("Seaweed-") and v}
         n = Needle(cookie=fid.cookie, id=fid.key, data=data, name=name,
                    mime=mime, ttl=t.TTL.parse(req.query.get("ttl", "")),
+                   pairs=(json.dumps(pair_map).encode()
+                          if pair_map else b""),
                    last_modified=int(time.time()))
         n.set_flag(FLAG_HAS_LAST_MODIFIED)
         if req.query.get("cm") in ("true", "1"):
@@ -478,6 +529,10 @@ class VolumeServer:
         except NotFound:
             return web.json_response({"error": "volume not found"},
                                      status=404)
+        except NeedleError as e:
+            # e.g. >64KB of Seaweed-* pair headers: a client error, not
+            # an unhandled 500 (needle.py:122 pairs-size limit)
+            return web.json_response({"error": str(e)}, status=400)
         except VolumeError as e:
             return web.json_response({"error": str(e)}, status=409)
         # replicate unless this IS a replica write (store_replicate.go:21)

@@ -121,3 +121,83 @@ def test_sequencer_syncs_from_heartbeat(tmp_path):
             key2 = int(a2["fid"].split(",")[1][:-8], 16)
             assert key2 > key1
     run(body())
+
+
+def test_conditional_reads_304(tmp_path):
+    """ETag + Last-Modified conditional GETs
+    (volume_server_handlers_read.go:102-116)."""
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            a = await c.assign()
+            st, _ = await c.put(a["fid"], a["url"], b"conditional-body")
+            assert st == 201
+            url = f"http://{a['url']}/{a['fid']}"
+            async with c.http.get(url) as resp:
+                assert resp.status == 200
+                etag = resp.headers["Etag"]
+                lm = resp.headers["Last-Modified"]
+            async with c.http.get(
+                    url, headers={"If-None-Match": etag}) as resp:
+                assert resp.status == 304
+                assert await resp.read() == b""
+            async with c.http.get(
+                    url, headers={"If-None-Match": '"deadbeef"'}) as resp:
+                assert resp.status == 200
+            async with c.http.get(
+                    url, headers={"If-Modified-Since": lm}) as resp:
+                assert resp.status == 304
+            async with c.http.get(
+                    url, headers={"If-Modified-Since":
+                                  "Thu, 01 Jan 1970 00:00:00 GMT"}) as resp:
+                assert resp.status == 200
+            # garbage date: served normally, not an error
+            async with c.http.get(
+                    url, headers={"If-Modified-Since": "not-a-date"}) as resp:
+                assert resp.status == 200
+    run(body())
+
+
+def test_pairs_headers_and_md5_etag(tmp_path):
+    """Seaweed-* upload headers round-trip as needle pairs and come back
+    as response headers (needle.go:19 PairNamePrefix,
+    volume_server_handlers_read.go:117-132)."""
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            a = await c.assign()
+            url = f"http://{a['url']}/{a['fid']}"
+            async with c.http.post(
+                    url, data=b"paired",
+                    headers={"Seaweed-X-Trace": "t-123",
+                             "Seaweed-Owner": "alice"}) as resp:
+                assert resp.status == 201, await resp.text()
+            async with c.http.get(url) as resp:
+                assert resp.status == 200
+                assert resp.headers["Seaweed-X-Trace"] == "t-123"
+                assert resp.headers["Seaweed-Owner"] == "alice"
+                crc_etag = resp.headers["Etag"]
+            import hashlib
+            async with c.http.get(
+                    url, headers={"ETag-MD5": "True"}) as resp:
+                md5 = hashlib.md5(b"paired").hexdigest()
+                assert resp.headers["Etag"] == f'"{md5}"'
+                assert resp.headers["Etag"] != crc_etag
+
+            # lowercase prefix counts (Go canonicalizes header casing)
+            a2 = await c.assign()
+            url2 = f"http://{a2['url']}/{a2['fid']}"
+            async with c.http.post(
+                    url2, data=b"x",
+                    headers={"seaweed-lower": "yes"}) as resp:
+                assert resp.status == 201
+            async with c.http.get(url2) as resp:
+                assert resp.headers["Seaweed-Lower"] == "yes"
+
+            # >64KB of pair headers: clean 400, not an unhandled 500
+            a3 = await c.assign()
+            async with c.http.post(
+                    f"http://{a3['url']}/{a3['fid']}", data=b"x",
+                    headers={f"Seaweed-K{i}": "v" * 7000
+                             for i in range(10)}) as resp:
+                assert resp.status == 400
+                assert "pairs" in (await resp.json())["error"]
+    run(body())
