@@ -33,6 +33,28 @@ from ..storage.volume import AlreadyDeleted, NotFound, VolumeError
 from ..security import tls
 
 
+def _disposition(req: "web.Request", fname: str) -> str:
+    """Content-Disposition value with ?dl=true attachment support
+    (writeResponseContent, volume_server_handlers_read.go:239-247).
+    Control characters are stripped — a CR/LF in a stored name would
+    otherwise kill the response in the header serializer."""
+    fname = "".join(ch for ch in fname if ch >= " ")
+    disp = ("attachment"
+            if req.query.get("dl", "").lower() in ("1", "true")
+            else "inline")
+    escaped = fname.replace("\\", "\\\\").replace('"', '\\"')
+    return f'{disp}; filename="{escaped}"'
+
+
+def _guess_mime(fname: str, default: str) -> str:
+    """Extension-derived mime, ONLY for plain extensions: guess_type
+    splits 'a.tar.gz' into (application/x-tar, gzip) and serving the
+    inner type for compressed bytes would mislabel the body."""
+    import mimetypes
+    guess, enc = mimetypes.guess_type(fname)
+    return guess if guess and enc is None else default
+
+
 class VolumeServer:
     def __init__(self, store: Store, master_url: str,
                  ip: str = "127.0.0.1", port: int = 8080,
@@ -340,6 +362,12 @@ class VolumeServer:
             else:
                 body = gzip.decompress(body)
         ct = n.mime.decode() if n.mime else "application/octet-stream"
+        if n.name:
+            # filename-derived mime + Content-Disposition, ?dl=true for
+            # attachment (writeResponseContent, read.go:229-248)
+            fname = n.name.decode(errors="replace")
+            ct = _guess_mime(fname, ct) if not n.mime else ct
+            headers["Content-Disposition"] = _disposition(req, fname)
         # on-read image resize (volume_server_handlers_read.go:211-227)
         if ("width" in req.query or "height" in req.query) \
                 and "Content-Encoding" not in headers \
@@ -413,8 +441,9 @@ class VolumeServer:
         ct = cm.mime or (n.mime.decode() if n.mime
                          else "application/octet-stream")
         if cm.name:
-            headers["Content-Disposition"] = \
-                f'inline; filename="{cm.name}"'
+            if not cm.mime and not n.mime:
+                ct = _guess_mime(cm.name, ct)
+            headers["Content-Disposition"] = _disposition(req, cm.name)
         try:
             rng = parse_range(req.headers.get("Range", ""), cm.size)
         except RangeError:

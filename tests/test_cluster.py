@@ -201,3 +201,27 @@ def test_pairs_headers_and_md5_etag(tmp_path):
                 assert resp.status == 400
                 assert "pairs" in (await resp.json())["error"]
     run(body())
+
+
+def test_filename_disposition_and_mime_guess(tmp_path):
+    """Needle-name-derived Content-Disposition / mime, ?dl=true download
+    (writeResponseContent, volume_server_handlers_read.go:229-248)."""
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            import aiohttp
+            a = await c.assign()
+            url = f"http://{a['url']}/{a['fid']}"
+            form = aiohttp.FormData()
+            # no part content-type: mime must be guessed from the name
+            form.add_field("file", b"<html></html>", filename="page.html")
+            async with c.http.post(url, data=form) as resp:
+                assert resp.status == 201, await resp.text()
+            async with c.http.get(url) as resp:
+                assert resp.status == 200
+                assert resp.content_type == "text/html"
+                assert resp.headers["Content-Disposition"] == \
+                    'inline; filename="page.html"'
+            async with c.http.get(url + "?dl=true") as resp:
+                assert resp.headers["Content-Disposition"].startswith(
+                    "attachment;")
+    run(body())
