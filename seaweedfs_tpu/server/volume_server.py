@@ -112,6 +112,7 @@ class VolumeServer:
         app.router.add_post("/admin/ec/delete_shards", self.h_ec_delete_shards)
         app.router.add_post("/admin/ec/to_volume", self.h_ec_to_volume)
         app.router.add_get("/admin/ec/shard_read", self.h_ec_shard_read)
+        app.router.add_post("/admin/batch_delete", self.h_batch_delete)
         app.router.add_get("/admin/file", self.h_admin_file)
         app.router.add_post("/admin/query", self.h_query)
         app.router.add_post("/admin/tier/upload", self.h_tier_upload)
@@ -628,6 +629,66 @@ class VolumeServer:
                     await self._replicate(req.match_info["fid"],
                                           "DELETE", None, auth=auth)
         return web.json_response({"size": size})
+
+    async def h_batch_delete(self, req: web.Request) -> web.Response:
+        """One request tombstones many needles locally, with a per-fid
+        result row (BatchDelete, volume_grpc_batch_delete.go:13-75).
+        Replica/EC fan-out is the CLIENT's job — delete_content.go groups
+        fids by holding server — so this endpoint never cascades; chunk
+        manifests are rejected for the same reason."""
+        try:
+            body = await req.json()
+        except ValueError:
+            body = None
+        if not isinstance(body, dict) or \
+                not isinstance(body.get("fileIds", []), list):
+            return web.json_response({"error": "bad json body"},
+                                     status=400)
+        fids = body.get("fileIds", [])
+        tokens = body.get("tokens", {})
+        if not isinstance(tokens, dict):
+            tokens = {}
+
+        def one(fid_s) -> dict:
+            if not isinstance(fid_s, str):
+                return {"fileId": str(fid_s), "status": 400,
+                        "error": "fileId must be a string"}
+            if self.jwt_key:
+                # the batch path must not bypass the write-token guard
+                # the public DELETE enforces (handlers_write.go:41-44)
+                from ..security.jwt import JwtError, check_write_jwt
+                try:
+                    check_write_jwt(self.jwt_key,
+                                    str(tokens.get(fid_s, "")), fid_s)
+                except JwtError as e:
+                    return {"fileId": fid_s, "status": 401,
+                            "error": str(e)}
+            try:
+                fid = self._parse_fid(fid_s)
+            except ValueError as e:
+                return {"fileId": fid_s, "status": 400, "error": str(e)}
+            try:
+                existing = self.store.read_needle(
+                    fid.volume_id, fid.key, fid.cookie)
+            except (NotFound, AlreadyDeleted) as e:
+                return {"fileId": fid_s, "status": 404,
+                        "error": str(e) or "not found"}
+            except (CrcMismatch, VolumeError, BackendError) as e:
+                return {"fileId": fid_s, "status": 500, "error": str(e)}
+            if existing.is_chunked_manifest:
+                return {"fileId": fid_s, "status": 406, "error":
+                        "ChunkManifest: not allowed in batch delete mode."}
+            try:
+                size = self.store.delete_needle(
+                    fid.volume_id, Needle(cookie=fid.cookie, id=fid.key))
+            except (NotFound, VolumeError) as e:
+                return {"fileId": fid_s, "status": 500, "error": str(e)}
+            return {"fileId": fid_s, "status": 202, "size": size}
+
+        loop = asyncio.get_running_loop()
+        results = await loop.run_in_executor(
+            None, lambda: [one(f) for f in fids])
+        return web.json_response({"results": results})
 
     async def _ec_delete_broadcast(self, vid: int, fid: str,
                                    auth: str = "") -> None:

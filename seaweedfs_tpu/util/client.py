@@ -188,7 +188,7 @@ class WeedClient:
             for loc in locs:
                 by_server.setdefault(loc["url"], []).append(fid)
 
-        async def drop(server: str, batch: list[str]) -> int:
+        async def drop_one_by_one(server: str, batch: list[str]) -> int:
             n = 0
             for fid in batch:
                 headers = {}
@@ -204,6 +204,34 @@ class WeedClient:
                 except aiohttp.ClientError:
                     pass
             return n
+
+        async def drop(server: str, batch: list[str]) -> int:
+            # one round trip per holding server via the batch endpoint
+            # (volume_grpc_batch_delete.go analog), with per-fid write
+            # tokens when the cluster enforces them
+            payload: dict = {"fileIds": batch}
+            if self.jwt_key:
+                payload["tokens"] = {f: self._mint_jwt(f) for f in batch}
+            try:
+                async with self.http.post(
+                        tls.url(server, "/admin/batch_delete"),
+                        json=payload) as resp:
+                    if resp.status == 200:
+                        res = (await resp.json()).get("results", [])
+                        ok = sum(r.get("status") in (200, 202)
+                                 for r in res)
+                        # rows the batch mode cannot handle (406 chunk
+                        # manifests, transient 5xx) still get the
+                        # per-fid tombstone the old path gave them
+                        retry = [r.get("fileId") for r in res
+                                 if r.get("status") in (406, 500, 503)]
+                        if retry:
+                            ok += await drop_one_by_one(server, retry)
+                        return ok
+            except (aiohttp.ClientError, ValueError):
+                pass
+            # endpoint unavailable: per-fid tombstones
+            return await drop_one_by_one(server, batch)
 
         counts = await asyncio.gather(
             *(drop(s, b) for s, b in by_server.items()))

@@ -225,3 +225,34 @@ def test_filename_disposition_and_mime_guess(tmp_path):
                 assert resp.headers["Content-Disposition"].startswith(
                     "attachment;")
     run(body())
+
+
+def test_batch_delete_endpoint(tmp_path):
+    """Server-side batch tombstone with per-fid results
+    (volume_grpc_batch_delete.go:13-75)."""
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            fids = []
+            for i in range(3):
+                a = await c.assign()
+                st, _ = await c.put(a["fid"], a["url"], b"bd-%d" % i)
+                assert st == 201
+                fids.append((a["fid"], a["url"]))
+            url = fids[0][1]
+            gone = fids[0][0].split(",")[0] + ",ffffff00000000"
+            async with c.http.post(
+                    f"http://{url}/admin/batch_delete",
+                    json={"fileIds": [f for f, _ in fids]
+                          + ["not-a-fid", gone]}) as resp:
+                assert resp.status == 200
+                results = (await resp.json())["results"]
+            by_fid = {r["fileId"]: r for r in results}
+            for f, _ in fids:
+                assert by_fid[f]["status"] == 202
+                assert by_fid[f]["size"] > 0
+            assert by_fid["not-a-fid"]["status"] == 400
+            assert by_fid[gone]["status"] == 404
+            for f, u in fids:
+                st, _ = await c.get(f, u)
+                assert st == 404
+    run(body())

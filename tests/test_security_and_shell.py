@@ -45,6 +45,24 @@ def test_cluster_enforces_jwt(tmp_path):
             assert st == 200 and data == b"x"
             # delete without token -> 401
             assert await c.delete(a["fid"], a["url"]) == 401
+            # batch delete must not bypass the write-token guard
+            async with c.http.post(
+                    f"http://{a['url']}/admin/batch_delete",
+                    json={"fileIds": [a["fid"]]}) as r:
+                assert r.status == 200
+                rows = (await r.json())["results"]
+            assert rows[0]["status"] == 401
+            st, _ = await c.get(a["fid"], a["url"])
+            assert st == 200  # still there
+            # with a per-fid token the batch tombstones it
+            async with c.http.post(
+                    f"http://{a['url']}/admin/batch_delete",
+                    json={"fileIds": [a["fid"]],
+                          "tokens": {a["fid"]: a["auth"]}}) as r:
+                rows = (await r.json())["results"]
+            assert rows[0]["status"] == 202
+            st, _ = await c.get(a["fid"], a["url"])
+            assert st == 404
     run(body())
 
 
