@@ -233,6 +233,8 @@ def build_parser() -> argparse.ArgumentParser:
                          "YYYY-MM-DDThh:mm:ss")
     ex.add_argument("-pattern", default="",
                     help="only file names matching this glob")
+    ex.add_argument("-limit", type=int, default=0,
+                    help="stop after this many entries (0 = all)")
 
     co = sub.add_parser("compact", help="offline-compact one volume")
     co.add_argument("-dir", default=".")
@@ -908,6 +910,9 @@ def _run_export(args) -> None:
     tar = tarfile.open(args.output, "w") if args.output else None
     exported = 0
 
+    class _LimitReached(Exception):
+        pass
+
     def want(n) -> bool:
         name = n.name.decode(errors="replace")
         if args.pattern and not fnmatch.fnmatch(name, args.pattern):
@@ -927,6 +932,8 @@ def _run_export(args) -> None:
 
     def visit(n, offset):
         nonlocal exported
+        if args.limit > 0 and exported >= args.limit:
+            raise _LimitReached
         kind = "tombstone" if n.size == 0 and not n.data else "needle"
         if tar is None:
             # listing mode keeps every historical record (incl.
@@ -938,6 +945,7 @@ def _run_export(args) -> None:
                 "offset": offset, "name": n.name.decode(errors="replace"),
                 "mime": n.mime.decode(errors="replace"), "type": kind,
                 "live": kind == "needle" and _is_live(n, offset)}))
+            exported += 1
             return
         if kind == "tombstone" or not want(n) or not _is_live(n, offset):
             return
@@ -950,7 +958,10 @@ def _run_export(args) -> None:
         info.mtime = int(getattr(n, "last_modified", 0) or 0)
         tar.addfile(info, io.BytesIO(bytes(n.data)))
 
-    v.scan(visit)
+    try:
+        v.scan(visit)
+    except _LimitReached:
+        pass
     v.close()
     if tar is not None:
         tar.close()

@@ -505,11 +505,20 @@ class VolumeServer:
         # casing before the prefix check, so 'seaweed-owner' must count
         pair_map = {k.title(): v for k, v in req.headers.items()
                     if k.title().startswith("Seaweed-") and v}
+        try:
+            # client-supplied modified time (needle.go:80 "ts")
+            last_modified = int(req.query.get("ts", "") or time.time())
+        except ValueError:
+            last_modified = int(time.time())
+        if not 0 <= last_modified < (1 << 40):
+            # out of the 5-byte on-disk range: a negative/overflowed ts
+            # must not crash serialization or corrupt TTL math
+            last_modified = int(time.time())
         n = Needle(cookie=fid.cookie, id=fid.key, data=data, name=name,
                    mime=mime, ttl=t.TTL.parse(req.query.get("ttl", "")),
                    pairs=(json.dumps(pair_map).encode()
                           if pair_map else b""),
-                   last_modified=int(time.time()))
+                   last_modified=last_modified)
         n.set_flag(FLAG_HAS_LAST_MODIFIED)
         if req.query.get("cm") in ("true", "1"):
             # chunk-manifest needle (needle_parse_multipart.go:86)
