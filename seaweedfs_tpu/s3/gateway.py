@@ -184,8 +184,40 @@ class S3Gateway:
         if req.method == "GET":
             if self.filer.find_entry(path) is None:
                 return _err("NoSuchBucket", bucket, 404)
+            if "uploads" in req.query:
+                return self._list_multipart_uploads(bucket)
             return await self._list_objects(req, bucket)
         return _err("MethodNotAllowed", req.method, 405)
+
+    def _list_multipart_uploads(self, bucket: str) -> web.Response:
+        """ListMultipartUploads (s3api_server.go:59): every in-progress
+        upload targeting this bucket, from the shared uploads dir."""
+        root = ET.Element("ListMultipartUploadsResult", xmlns=_NS)
+        ET.SubElement(root, "Bucket").text = bucket
+        # page through the SHARED uploads dir completely — a capped
+        # single listing would silently drop uploads for this bucket
+        # once the global in-progress count passes the cap
+        ups: list = []
+        start = ""
+        while True:
+            try:
+                page = self.filer.list_directory_entries(
+                    UPLOADS_DIR, start, False, 1024)
+            except FilerError:
+                break
+            ups.extend(page)
+            if len(page) < 1024:
+                break
+            start = page[-1].name
+        for e in ups:
+            meta = e.extended or {}
+            if meta.get("bucket") != bucket:
+                continue
+            el = ET.SubElement(root, "Upload")
+            ET.SubElement(el, "Key").text = str(meta.get("key", ""))
+            ET.SubElement(el, "UploadId").text = e.name
+            ET.SubElement(el, "Initiated").text = _ts(e.attr.crtime)
+        return _xml(root)
 
     async def _list_objects(self, req: web.Request,
                             bucket: str) -> web.Response:

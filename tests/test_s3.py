@@ -121,6 +121,17 @@ def test_multipart_upload(tmp_path):
             async with c.http.get(f"{s3}/mp/big.bin",
                                   params={"uploadId": upload_id}) as r:
                 assert _tags(await r.read(), "PartNumber") == ["1", "2"]
+            # the in-progress upload shows in ListMultipartUploads
+            async with c.http.get(f"{s3}/mp",
+                                  params={"uploads": ""}) as r:
+                body_ = await r.read()
+                assert _tags(body_, "UploadId") == [upload_id]
+                assert _tags(body_, "Key") == ["big.bin"]
+            # ...scoped to its own bucket
+            await c.http.put(f"{s3}/other")
+            async with c.http.get(f"{s3}/other",
+                                  params={"uploads": ""}) as r:
+                assert _tags(await r.read(), "UploadId") == []
             async with c.http.post(f"{s3}/mp/big.bin",
                                    params={"uploadId": upload_id}) as r:
                 assert r.status == 200
