@@ -116,20 +116,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="only copy names matching this glob (e.g. *.txt)")
 
     fr = sub.add_parser("filer.replicate",
-                        help="replay filer meta events into a sink")
-    fr.add_argument("-notify", required=True,
+                        help="replay filer meta events into a sink "
+                             "(flags fall back to replication.toml)")
+    fr.add_argument("-notify", default="",
                     help="subscription input: file:<path> | sqlite:<path> "
                          "| kafka:<hosts>/<topic>[@offsets] | "
                          "sqs:<region>/<queue> | pubsub:<project>/<topic>")
-    fr.add_argument("-sourceMaster", required=True,
+    fr.add_argument("-sourceMaster", default="",
                     help="source cluster master host:port")
-    fr.add_argument("-sourceDir", default="/",
+    fr.add_argument("-sourceDir", default="",
                     help="replicate only this subtree")
-    fr.add_argument("-sink", required=True,
+    fr.add_argument("-sink", default="",
                     help="filer:<filerHost:port>@<targetMaster> | "
                          "s3:<endpointUrl>/<bucket> | local:<dir>")
-    fr.add_argument("-sinkDir", default="/")
-    fr.add_argument("-progress", default="./replicate.progress")
+    fr.add_argument("-sinkDir", default="")
+    fr.add_argument("-progress", default="")
     fr.add_argument("-once", action="store_true",
                     help="process the backlog and exit")
 
@@ -243,7 +244,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sc = sub.add_parser("scaffold", help="print example config TOML")
     sc.add_argument("-config", default="security",
-                    choices=["security", "master", "filer"])
+                    choices=["security", "master", "filer",
+                             "notification", "replication"])
 
     mt = sub.add_parser("mount", help="mount the filer as a FUSE "
                                       "filesystem (requires fusepy)")
@@ -265,35 +267,77 @@ def build_parser() -> argparse.ArgumentParser:
 # ---------------------------------------------------------------------------
 
 
-def _load_master_toml() -> dict:
-    """viper-style discovery of master.toml (./, ~/.seaweedfs,
-    /etc/seaweedfs): [master.maintenance] scripts + sleep_minutes and
-    [master.sequencer] type (scaffold.go:337-371 semantics)."""
+def _find_config_toml(name: str) -> tuple[str, dict] | None:
+    """viper-style discovery of <name>.toml in ./, ~/.seaweedfs,
+    /etc/seaweedfs (util/config.go:28-45); returns (path, parsed)."""
     import tomllib
     for d in (".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"):
-        path = os.path.join(d, "master.toml")
-        if not os.path.exists(path):
-            continue
-        with open(path, "rb") as f:
-            cfg = tomllib.load(f)
-        out = {}
-        maint = cfg.get("master", {}).get("maintenance", {})
-        if maint.get("scripts"):
-            out["admin_scripts"] = [
-                ln.strip() for ln in maint["scripts"].splitlines()
-                if ln.strip() and not ln.strip().startswith("#")]
-        if "sleep_minutes" in maint:
-            out["admin_scripts_interval_s"] = \
-                float(maint["sleep_minutes"]) * 60
-        seq = cfg.get("master", {}).get("sequencer", {})
-        if seq.get("type") and seq["type"] != "memory":
-            val = seq["type"]
-            out["sequencer"] = (val if ":" in val
-                                else f"{val}:{seq.get('path', '')}")
+        path = os.path.join(d, f"{name}.toml")
+        if os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    return path, tomllib.load(f)
+            except tomllib.TOMLDecodeError as e:
+                # a broken discovered config must fail loud and clean,
+                # never a raw traceback from a command that may not even
+                # need the file
+                raise SystemExit(f"{path}: {e}")
+    return None
+
+
+def _discover_notification_queue():
+    """Discovered notification.toml (the scaffold's [notification.*]
+    enabled sections, configuration.go:24-58). Used by every command
+    that embeds a filer when -notify is not given; returns the one
+    enabled queue or None. Config errors exit cleanly like the -notify
+    flag path does."""
+    found = _find_config_toml("notification")
+    if found is None:
+        return None
+    path, cfg = found
+    from .notification.queues import load_configuration
+    try:
+        q = load_configuration(cfg.get("notification"))
+    except (ValueError, RuntimeError, KeyError) as e:
+        raise SystemExit(f"{path}: {e}")
+    if q is not None:
         from .util import glog
-        glog.info("master config loaded from %s", path)
-        return out
-    return {}
+        glog.info("notification queue %s from %s", q.name, path)
+    return q
+
+
+def _attach_discovered_queue(filer) -> None:
+    q = _discover_notification_queue()
+    if q is not None:
+        from .notification.queues import attach_to_filer
+        attach_to_filer(filer, q)
+
+
+def _load_master_toml() -> dict:
+    """Discovered master.toml: [master.maintenance] scripts +
+    sleep_minutes and [master.sequencer] type (scaffold.go:337-371
+    semantics)."""
+    found = _find_config_toml("master")
+    if found is None:
+        return {}
+    path, cfg = found
+    out = {}
+    maint = cfg.get("master", {}).get("maintenance", {})
+    if maint.get("scripts"):
+        out["admin_scripts"] = [
+            ln.strip() for ln in maint["scripts"].splitlines()
+            if ln.strip() and not ln.strip().startswith("#")]
+    if "sleep_minutes" in maint:
+        out["admin_scripts_interval_s"] = \
+            float(maint["sleep_minutes"]) * 60
+    seq = cfg.get("master", {}).get("sequencer", {})
+    if seq.get("type") and seq["type"] != "memory":
+        val = seq["type"]
+        out["sequencer"] = (val if ":" in val
+                            else f"{val}:{seq.get('path', '')}")
+    from .util import glog
+    glog.info("master config loaded from %s", path)
+    return out
 
 
 async def _serve_until_interrupt(*servers) -> None:
@@ -390,6 +434,8 @@ async def _run_filer(args) -> None:
     if args.notify:
         from .notification.queues import attach_to_filer
         attach_to_filer(filer, _make_queue(args.notify))
+    else:
+        _attach_discovered_queue(filer)
     fs = FilerServer(filer, args.master,
                      ip=args.ip, port=args.port,
                      chunk_size=args.chunkSizeMB * 1024 * 1024,
@@ -537,13 +583,30 @@ async def _run_filer_replicate(args) -> None:
     from .replication.replicator import Replicator
     from .replication.runner import replicate_from_queue
     from .replication.source import FilerSource
-    queue = _make_subscription(args.notify)
-    sink = _make_sink(args.sink, args.sinkDir)
-    async with FilerSource(args.sourceMaster, args.sourceDir) as src:
+    # flags win; replication.toml [replication] fills whatever is absent
+    found = _find_config_toml("replication")
+    cfg = found[1].get("replication", {}) if found else {}
+    notify = args.notify or cfg.get("notify", "")
+    source_master = args.sourceMaster or cfg.get("sourceMaster", "")
+    source_dir = args.sourceDir or cfg.get("sourceDir", "/")
+    sink_spec = args.sink or cfg.get("sink", "")
+    sink_dir = args.sinkDir or cfg.get("sinkDir", "/")
+    progress = args.progress or cfg.get("progress",
+                                        "./replicate.progress")
+    missing = [f for f, v in (("-notify", notify),
+                              ("-sourceMaster", source_master),
+                              ("-sink", sink_spec)) if not v]
+    if missing:
+        raise SystemExit(
+            f"filer.replicate needs {', '.join(missing)} (flags or "
+            f"replication.toml [replication] keys)")
+    queue = _make_subscription(notify)
+    sink = _make_sink(sink_spec, sink_dir)
+    async with FilerSource(source_master, source_dir) as src:
         await sink.start()
         try:
             n = await replicate_from_queue(
-                queue, Replicator(src, sink), args.progress,
+                queue, Replicator(src, sink), progress,
                 once=args.once)
             if args.once:
                 print(f"replicated {n} events")
@@ -560,7 +623,9 @@ async def _run_s3(args) -> None:
     kwargs = _store_kwargs(args.store, args.dbPath)
     identities = ({args.accessKey: args.secretKey}
                   if args.accessKey else None)
-    s3 = S3Gateway(Filer(args.store, **kwargs), args.master,
+    filer = Filer(args.store, **kwargs)
+    _attach_discovered_queue(filer)
+    s3 = S3Gateway(filer, args.master,
                    ip=args.ip, port=args.port, identities=identities)
     await s3.start()
     print(f"s3 gateway listening on {s3.url}")
@@ -571,7 +636,9 @@ async def _run_webdav(args) -> None:
     from .filer.filer import Filer
     from .server.webdav_server import WebDavServer
     kwargs = _store_kwargs(args.store, args.dbPath)
-    wd = WebDavServer(Filer(args.store, **kwargs), args.master,
+    filer = Filer(args.store, **kwargs)
+    _attach_discovered_queue(filer)
+    wd = WebDavServer(filer, args.master,
                       ip=args.ip, port=args.port,
                       collection=args.collection,
                       replication=args.replication,
@@ -601,9 +668,11 @@ async def _run_server(args) -> None:
     filer_srv = None
     s3 = None
     if args.filer or args.s3:
+        combined_filer = Filer("sqlite",
+                               path=os.path.join(args.dir, "filer.db"))
+        _attach_discovered_queue(combined_filer)
         filer_srv = FilerServer(
-            Filer("sqlite", path=os.path.join(args.dir, "filer.db")),
-            m.url, ip=args.ip, port=args.filerPort)
+            combined_filer, m.url, ip=args.ip, port=args.filerPort)
         await filer_srv.start()
         parts.append(f"filer={filer_srv.url}")
     if args.s3:
@@ -1016,19 +1085,67 @@ enabled = false
 enabled = true
 path = "./filer.db"
 """,
+    "notification": """# notification.toml (weed scaffold -config=notification)
+# exactly ONE queue may be enabled; the filer publishes an
+# EventNotification per meta change to it (filer_notify.go:9-31)
+
+[notification.log]
+enabled = false
+
+[notification.file]
+enabled = false
+path = "./filer.events"
+
+[notification.sqlite]
+enabled = false
+path = "./filer.events.db"
+
+[notification.kafka]
+enabled = false
+hosts = ["localhost:9092"]
+topic = "seaweedfs_filer"
+
+[notification.aws_sqs]
+enabled = false
+region = "us-east-2"
+sqs_queue_name = "my_sqs_queue"
+
+[notification.google_pub_sub]
+enabled = false
+project_id = ""
+topic = "seaweedfs_filer_topic"
+""",
+    "replication": """# replication.toml (weed scaffold -config=replication)
+# Consumed by `weed-tpu filer.replicate` when the corresponding flags
+# are not given. Every key maps 1:1 to a flag (see -h).
+
+[replication]
+# -notify: subscription input
+#   file:<path> | sqlite:<path> | kafka:<hosts>/<topic>[@offsets] |
+#   sqs:<region>/<queue> | pubsub:<project>/<topic>
+notify = "file:./filer.events"
+# -sourceMaster / -sourceDir: cluster + subtree the events refer to
+sourceMaster = "localhost:9333"
+sourceDir = "/"
+# -sink / -sinkDir: replication target
+#   filer:<filerHost:port>@<targetMaster> | s3:<endpointUrl>/<bucket>
+#   | local:<dir>
+sink = "local:/data/backup"
+sinkDir = "/"
+# -progress: consumed-offset checkpoint file (resume-after-restart)
+progress = "./replicate.progress"
+""",
 }
 
 
 def _discover_security_toml() -> None:
-    """viper-style config discovery: ./, ~/.seaweedfs/, /etc/seaweedfs/
-    (util/config.go:28-45). Enables mTLS when [tls] is configured."""
-    for d in (".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"):
-        path = os.path.join(d, "security.toml")
-        if os.path.exists(path):
-            from .util import glog
-            if tls.configure_from_toml(path):
-                glog.info("mTLS enabled from %s", path)
-            return
+    """Discovered security.toml enables mTLS when [tls] is configured
+    (util/config.go:28-45 search order)."""
+    found = _find_config_toml("security")
+    if found is not None:
+        from .util import glog
+        if tls.configure_from_toml(found[0], found[1]):
+            glog.info("mTLS enabled from %s", found[0])
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -41,12 +41,14 @@ def configure(ca: str, cert: str, key: str,
     _client_ctx = cctx
 
 
-def configure_from_toml(path: str) -> bool:
-    """Parse the [tls] section of a security.toml; returns True if TLS
-    was enabled. Absent/empty section leaves plaintext HTTP."""
-    import tomllib
-    with open(path, "rb") as f:
-        cfg = tomllib.load(f)
+def configure_from_toml(path: str, cfg: dict | None = None) -> bool:
+    """Apply the [tls] section of a security.toml (pass cfg when the
+    file is already parsed); returns True if TLS was enabled.
+    Absent/empty section leaves plaintext HTTP."""
+    if cfg is None:
+        import tomllib
+        with open(path, "rb") as f:
+            cfg = tomllib.load(f)
     tls = cfg.get("tls", {})
     if not (tls.get("cert") or tls.get("ca") or tls.get("key")):
         return False
