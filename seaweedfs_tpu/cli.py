@@ -7,6 +7,7 @@ command/command.go:10-30). Run as `python -m seaweedfs_tpu.cli <cmd>`.
 from __future__ import annotations
 
 from .security import tls
+from .security.guard import parse_white_list
 
 import argparse
 import asyncio
@@ -63,6 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "exceeds this")
     m.add_argument("-maintenanceIntervalS", type=float, default=900.0,
                    help="auto-vacuum cadence seconds; 0 disables")
+    m.add_argument("-whiteList", default="",
+                   help="comma-separated IPs/CIDRs allowed to use the "
+                        "API; empty = no limit (guard.go)")
 
     v = sub.add_parser("volume", help="start a volume server")
     _add_common(v)
@@ -90,6 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("auto", "tpu", "cpu"),
                    help="erasure-coding engine (the reference-noted "
                         "-ec.backend switch): auto = tpu when attached")
+    v.add_argument("-publicUrl", default="",
+                   help="publicly accessible address advertised to "
+                        "clients (host:port)")
+    v.add_argument("-whiteList", default="",
+                   help="comma-separated IPs/CIDRs with write/admin "
+                        "permission; empty = no limit")
 
     f = sub.add_parser("filer", help="start a filer server")
     _add_common(f)
@@ -378,7 +388,8 @@ async def _run_master(args) -> None:
                      maintenance_interval_s=args.maintenanceIntervalS,
                      admin_scripts=toml_cfg.get("admin_scripts"),
                      admin_scripts_interval_s=toml_cfg.get(
-                         "admin_scripts_interval_s", 17 * 60.0))
+                         "admin_scripts_interval_s", 17 * 60.0),
+                     white_list=parse_white_list(args.whiteList))
     await m.start()
     if args.metricsGateway:
         from .stats.metrics import push_loop
@@ -412,7 +423,9 @@ async def _run_volume(args) -> None:
                   index_type=args.index)
     vs = VolumeServer(store, args.master, ip=args.ip, port=args.port,
                       data_center=args.dataCenter, rack=args.rack,
-                      pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey)
+                      pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
+                      white_list=parse_white_list(args.whiteList),
+                      public_url=args.publicUrl)
     await vs.start()
     print(f"volume server listening on {vs.url}, dirs={dirs}")
     await _serve_until_interrupt(vs)

@@ -43,7 +43,12 @@ class MasterServer:
                  meta_dir: str = "",
                  maintenance_interval_s: float = 900.0,
                  admin_scripts: list[str] | None = None,
-                 admin_scripts_interval_s: float = 17 * 60.0):
+                 admin_scripts_interval_s: float = 17 * 60.0,
+                 white_list: list[str] | None = None):
+        from ..security.guard import Guard
+        # -whiteList: IP guard on the API surface (guard.go:43-137,
+        # wrapped handlers at master_server.go:110-120)
+        self.guard = Guard(white_list or ())
         self.ip = ip
         self.port = port
         self._peers = list(peers or [])
@@ -97,8 +102,23 @@ class MasterServer:
         self.app = self._build_app()
 
     # ------------------------------------------------------------------
+    # the client-API paths the reference wraps with guard.WhiteList
+    # (master_server.go:110-120). Deliberately NOT guarded: the UI, the
+    # fid redirect, the raft/heartbeat/watch mesh (mTLS-scoped instead)
+    # — and /dir/lookup, which volume servers call during replica
+    # fan-out (the reference's equivalent lookup rides gRPC, so its
+    # whitelist never sees it)
+    _GUARDED = ("/dir/assign", "/dir/status",
+                "/col/delete", "/vol/grow", "/vol/status", "/vol/vacuum",
+                "/vol/volumes", "/vol/ec_lookup", "/submit", "/stats/")
+
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=64 * 1024 * 1024)
+        from ..security.guard import middleware as guard_mw
+        app = web.Application(
+            client_max_size=64 * 1024 * 1024,
+            middlewares=[guard_mw(
+                lambda: self.guard,
+                lambda req: req.path.startswith(self._GUARDED))])
         app.router.add_route("*", "/dir/assign", self.h_assign)
         app.router.add_route("*", "/dir/lookup", self.h_lookup)
         app.router.add_get("/dir/status", self.h_dir_status)

@@ -61,7 +61,15 @@ class VolumeServer:
                  data_center: str = "", rack: str = "",
                  pulse_seconds: float = 5.0,
                  read_redirect: bool = True,
-                 jwt_key: str = ""):
+                 jwt_key: str = "",
+                 white_list: list[str] | None = None,
+                 public_url: str = ""):
+        self.public_url = public_url
+        from ..security.guard import Guard
+        # -whiteList (volume.go:87,125): IP guard over the admin surface
+        # and needle writes; reads stay open like the reference's public
+        # port
+        self.guard = Guard(white_list or ())
         self.jwt_key = jwt_key
         self.store = store
         # comma-separated seed list: chase the leader hint, rotate seeds on
@@ -85,8 +93,23 @@ class VolumeServer:
         self.app = self._build_app()
         store.fetch_remote_shard = None  # wired after start (needs loop)
 
+    @staticmethod
+    def _guarded_request(req: web.Request) -> bool:
+        # needle writes only: /admin/* is the inter-server mesh (master
+        # allocate/vacuum, peer copy/EC — mTLS-scoped like the
+        # reference's gRPC), and replica forwards come from peer volume
+        # servers an operator's client whitelist won't include — those
+        # still carry the per-fid write JWT when the cluster enforces it
+        return (req.method in ("POST", "PUT", "DELETE")
+                and not req.path.startswith("/admin/")
+                and req.query.get("type") != "replicate")
+
     def _build_app(self) -> web.Application:
-        app = web.Application(client_max_size=1024 * 1024 * 1024)
+        from ..security.guard import middleware as guard_mw
+        app = web.Application(
+            client_max_size=1024 * 1024 * 1024,
+            middlewares=[guard_mw(lambda: self.guard,
+                                  self._guarded_request)])
         # admin API (gRPC-analog)
         app.router.add_post("/admin/volume/allocate", self.h_allocate)
         app.router.add_post("/admin/volume/delete", self.h_volume_delete)
@@ -144,7 +167,12 @@ class VolumeServer:
             self.port = site._server.sockets[0].getsockname()[1]
         self.store.ip = self.ip
         self.store.port = self.port
-        if not self.store.public_url or self.store.public_url.endswith(":0"):
+        if self.public_url:
+            # -publicUrl (volume.go:60): the externally reachable
+            # address advertised in heartbeats/locations
+            self.store.public_url = self.public_url
+        elif not self.store.public_url or \
+                self.store.public_url.endswith(":0"):
             self.store.public_url = self.url
         # remote EC shard reads run inside executor threads, so they use a
         # synchronous client (readRemoteEcShardInterval, store_ec.go:211+)

@@ -1,0 +1,67 @@
+"""IP white-list guard (security/guard.go:43-137 semantics)."""
+
+from __future__ import annotations
+
+from cluster_util import Cluster, run
+
+from seaweedfs_tpu.security.guard import Guard, parse_white_list
+
+
+def test_guard_matching():
+    assert Guard(()).allows("10.0.0.1")  # empty list admits everyone
+    g = Guard(["127.0.0.1", "10.1.0.0/16"])
+    assert g.allows("127.0.0.1")
+    assert g.allows("10.1.255.3")
+    assert not g.allows("10.2.0.1")
+    assert not g.allows("192.168.0.9")
+    assert not g.allows(None)
+    assert not g.allows("not-an-ip")
+    assert parse_white_list(" 1.2.3.4 , 10.0.0.0/8 ,") == \
+        ["1.2.3.4", "10.0.0.0/8"]
+    # a typo'd entry fails fast instead of silently never matching
+    import pytest
+    with pytest.raises(ValueError):
+        Guard(["10.0.0.256"])
+
+
+def test_white_list_enforced_over_http(tmp_path):
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            # flip on a whitelist that excludes the loopback client
+            c.master.guard = Guard(["10.9.9.9"])
+            c.servers[0].guard = Guard(["10.9.9.9"])
+            async with c.http.get(
+                    f"http://{c.master.url}/dir/assign") as resp:
+                assert resp.status == 401
+            # the mesh stays open: cluster status, /dir/lookup (replica
+            # fan-out calls it), raft/heartbeat
+            async with c.http.get(
+                    f"http://{c.master.url}/cluster/status") as resp:
+                assert resp.status == 200
+            async with c.http.get(
+                    f"http://{c.master.url}/dir/lookup",
+                    params={"volumeId": "1"}) as resp:
+                assert resp.status != 401
+            # volume: client writes guarded; reads, the /admin mesh, and
+            # replica forwards (JWT-covered when enforced) stay open
+            vs = c.servers[0].url
+            async with c.http.post(f"http://{vs}/1,01deadbeef",
+                                   data=b"x") as resp:
+                assert resp.status == 401
+            async with c.http.post(
+                    f"http://{vs}/admin/vacuum/check",
+                    params={"volume": "1"}) as resp:
+                assert resp.status != 401
+            async with c.http.post(f"http://{vs}/9,01deadbeef",
+                                   data=b"x",
+                                   params={"type": "replicate"}) as resp:
+                assert resp.status != 401
+            async with c.http.get(f"http://{vs}/status") as resp:
+                assert resp.status == 200
+            # widen the list to include loopback: everything works again
+            c.master.guard = Guard(["127.0.0.0/8"])
+            c.servers[0].guard = Guard(["127.0.0.0/8"])
+            a = await c.assign()
+            st, _ = await c.put(a["fid"], a["url"], b"guarded-ok")
+            assert st == 201
+    run(body())
