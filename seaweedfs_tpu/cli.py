@@ -67,6 +67,8 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-whiteList", default="",
                    help="comma-separated IPs/CIDRs allowed to use the "
                         "API; empty = no limit (guard.go)")
+    m.add_argument("-volumePreallocate", action="store_true",
+                   help="preallocate disk space for grown volumes")
 
     v = sub.add_parser("volume", help="start a volume server")
     _add_common(v)
@@ -98,8 +100,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="publicly accessible address advertised to "
                         "clients (host:port)")
     v.add_argument("-whiteList", default="",
-                   help="comma-separated IPs/CIDRs with write/admin "
-                        "permission; empty = no limit")
+                   help="comma-separated IPs/CIDRs with needle-write "
+                        "permission; empty = no limit. The /admin mesh "
+                        "is protected by mTLS (security.toml), not by "
+                        "this list")
 
     f = sub.add_parser("filer", help="start a filer server")
     _add_common(f)
@@ -389,7 +393,8 @@ async def _run_master(args) -> None:
                      admin_scripts=toml_cfg.get("admin_scripts"),
                      admin_scripts_interval_s=toml_cfg.get(
                          "admin_scripts_interval_s", 17 * 60.0),
-                     white_list=parse_white_list(args.whiteList))
+                     white_list=parse_white_list(args.whiteList),
+                     volume_preallocate=args.volumePreallocate)
     await m.start()
     if args.metricsGateway:
         from .stats.metrics import push_loop

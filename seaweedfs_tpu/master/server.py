@@ -44,11 +44,15 @@ class MasterServer:
                  maintenance_interval_s: float = 900.0,
                  admin_scripts: list[str] | None = None,
                  admin_scripts_interval_s: float = 17 * 60.0,
-                 white_list: list[str] | None = None):
+                 white_list: list[str] | None = None,
+                 volume_preallocate: bool = False):
         from ..security.guard import Guard
         # -whiteList: IP guard on the API surface (guard.go:43-137,
         # wrapped handlers at master_server.go:110-120)
         self.guard = Guard(white_list or ())
+        # -volumePreallocate (master.go:51): grown volumes fallocate
+        # their full size limit up front
+        self.volume_preallocate = volume_preallocate
         self.ip = ip
         self.port = port
         self._peers = list(peers or [])
@@ -363,11 +367,14 @@ class MasterServer:
             # under it, or a successor leader could reissue it
             raise PlacementError(
                 f"vid {vid}: MaxVolumeId not replicated to a quorum")
+        prealloc = str(self.volume_size_limit
+                       if self.volume_preallocate else 0)
         for n in nodes:
             async with self._http.post(
                     tls.url(n.url, "/admin/volume/allocate"),
                     params={"volume": str(vid), "collection": collection,
-                            "replication": replication, "ttl": ttl}) as resp:
+                            "replication": replication, "ttl": ttl,
+                            "preallocate": prealloc}) as resp:
                 if resp.status != 200:
                     raise PlacementError(
                         f"allocate vid {vid} on {n.url}: "

@@ -93,16 +93,22 @@ class VolumeServer:
         self.app = self._build_app()
         store.fetch_remote_shard = None  # wired after start (needs loop)
 
-    @staticmethod
-    def _guarded_request(req: web.Request) -> bool:
+    def _guarded_request(self, req: web.Request) -> bool:
         # needle writes only: /admin/* is the inter-server mesh (master
         # allocate/vacuum, peer copy/EC — mTLS-scoped like the
-        # reference's gRPC), and replica forwards come from peer volume
-        # servers an operator's client whitelist won't include — those
-        # still carry the per-fid write JWT when the cluster enforces it
-        return (req.method in ("POST", "PUT", "DELETE")
-                and not req.path.startswith("/admin/")
-                and req.query.get("type") != "replicate")
+        # reference's gRPC). Replica forwards come from peer volume
+        # servers an operator's client whitelist won't include, so they
+        # are exempt ONLY when the cluster enforces write JWTs (the
+        # forwarded per-fid token still authenticates them); without a
+        # jwt key the exemption would be a trivial guard bypass, so
+        # peers must then be whitelisted
+        if req.method not in ("POST", "PUT", "DELETE"):
+            return False
+        if req.path.startswith("/admin/"):
+            return False
+        if req.query.get("type") == "replicate" and self.jwt_key:
+            return False
+        return True
 
     def _build_app(self) -> web.Application:
         from ..security.guard import middleware as guard_mw

@@ -141,10 +141,27 @@ class Volume:
             self._dat.write(self.super_block.to_bytes())
             self._dat.flush()
             if preallocate:
+                # FALLOC_FL_KEEP_SIZE (mode 1, volume_create_linux.go:19):
+                # reserve blocks WITHOUT extending st_size — appends
+                # derive their offset from the file size, so a plain
+                # posix_fallocate would push every write past the
+                # preallocated region
                 try:
-                    os.posix_fallocate(self._dat.fileno(), 0, preallocate)
-                except OSError:
-                    pass
+                    import ctypes
+                    libc = ctypes.CDLL(None, use_errno=True)
+                    # argtypes matter: off_t is 64-bit — the ctypes
+                    # default int conversion would truncate any
+                    # preallocation >= 2GB (incl. the 30GB default)
+                    libc.fallocate.argtypes = [
+                        ctypes.c_int, ctypes.c_int,
+                        ctypes.c_longlong, ctypes.c_longlong]
+                    libc.fallocate.restype = ctypes.c_int
+                    if libc.fallocate(self._dat.fileno(), 1, 0,
+                                      preallocate) != 0:
+                        raise OSError(ctypes.get_errno(), "fallocate")
+                except (OSError, AttributeError):
+                    pass  # unsupported fs: run unallocated, like the
+                    #       reference's non-linux build
         self.nm = best_needle_map(base + ".idx", self.needle_map_kind)
         self._check_integrity()
 

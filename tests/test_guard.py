@@ -52,10 +52,21 @@ def test_white_list_enforced_over_http(tmp_path):
                     f"http://{vs}/admin/vacuum/check",
                     params={"volume": "1"}) as resp:
                 assert resp.status != 401
+            # without write JWTs a ?type=replicate spoof must NOT bypass
+            # the IP guard (peers have to be whitelisted instead)
             async with c.http.post(f"http://{vs}/9,01deadbeef",
                                    data=b"x",
                                    params={"type": "replicate"}) as resp:
-                assert resp.status != 401
+                assert (await resp.json())["error"] == \
+                    "ip not in whitelist"
+            # with JWTs enforced, replica forwards skip the IP guard and
+            # are authenticated by their forwarded token instead
+            c.servers[0].jwt_key = "k"
+            async with c.http.post(f"http://{vs}/9,01deadbeef",
+                                   data=b"x",
+                                   params={"type": "replicate"}) as resp:
+                assert (await resp.json())["error"] == "missing jwt"
+            c.servers[0].jwt_key = ""
             async with c.http.get(f"http://{vs}/status") as resp:
                 assert resp.status == 200
             # widen the list to include loopback: everything works again

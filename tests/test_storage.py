@@ -257,3 +257,23 @@ def test_volume_ttl_expiry(tmp_path):
     with pytest.raises(NotFound):
         v.read_needle(1)
     v.close()
+
+
+def test_preallocate_keeps_append_offsets(tmp_path):
+    """Preallocation must reserve blocks WITHOUT moving the append tail
+    (FALLOC_FL_KEEP_SIZE, volume_create_linux.go:19): appends derive
+    their offset from st_size."""
+    from seaweedfs_tpu.storage.volume import Volume
+    from seaweedfs_tpu.storage.needle import Needle
+
+    v = Volume(str(tmp_path), "", 31, preallocate=1 << 20)
+    off, _ = v.write_needle(Needle(cookie=5, id=1, data=b"pre" * 50))
+    assert off < 4096, "append landed past the preallocated region"
+    assert v.data_size() < 4096
+    assert v.read_needle(1, cookie=5).data == b"pre" * 50
+    v.close()
+    # reload: integrity check passes, appends continue at the tail
+    v2 = Volume(str(tmp_path), "", 31, create_if_missing=False)
+    off2, _ = v2.write_needle(Needle(cookie=6, id=2, data=b"y"))
+    assert off < off2 < 8192
+    v2.close()
