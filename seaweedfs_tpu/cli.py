@@ -119,6 +119,14 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-notify", default="",
                    help="publish meta changes: file:<path> | sqlite:<path> "
                         "| log")
+    f.add_argument("-dataCenter", default="",
+                   help="prefer volumes in this data center for writes")
+    f.add_argument("-redirectOnRead", action="store_true",
+                   help="redirect single-chunk GETs to the volume server "
+                        "instead of proxying")
+    f.add_argument("-disableDirListing", action="store_true")
+    f.add_argument("-dirListLimit", type=int, default=100_000,
+                   help="cap on directory listing page size")
 
     fc = sub.add_parser("filer.copy",
                         help="parallel-upload local files/trees to a filer")
@@ -458,7 +466,11 @@ async def _run_filer(args) -> None:
                      ip=args.ip, port=args.port,
                      chunk_size=args.chunkSizeMB * 1024 * 1024,
                      collection=args.collection,
-                     replication=args.replication)
+                     replication=args.replication,
+                     data_center=args.dataCenter,
+                     redirect_on_read=args.redirectOnRead,
+                     disable_dir_listing=args.disableDirListing,
+                     dir_list_limit=args.dirListLimit)
     await fs.start()
     print(f"filer listening on {fs.url} (store={args.store})")
     await _serve_until_interrupt(fs)

@@ -27,7 +27,11 @@ class FilerServer:
     def __init__(self, filer: Filer, master_url: str,
                  ip: str = "127.0.0.1", port: int = 8888,
                  chunk_size: int = 32 * 1024 * 1024,
-                 collection: str = "", replication: str = ""):
+                 collection: str = "", replication: str = "",
+                 data_center: str = "",
+                 redirect_on_read: bool = False,
+                 disable_dir_listing: bool = False,
+                 dir_list_limit: int = 100_000):
         self.filer = filer
         self.master_url = master_url
         self.ip = ip
@@ -35,6 +39,11 @@ class FilerServer:
         self.chunk_size = chunk_size
         self.collection = collection
         self.replication = replication
+        # command/filer.go:50-54 knobs
+        self.data_center = data_center
+        self.redirect_on_read = redirect_on_read
+        self.disable_dir_listing = disable_dir_listing
+        self.dir_list_limit = dir_list_limit
         self._runner: web.AppRunner | None = None
         self._tasks: list[asyncio.Task] = []
         self.client: WeedClient | None = None
@@ -167,6 +176,23 @@ class FilerServer:
         if req.method == "HEAD":
             return web.Response(status=status, headers=headers,
                                 content_type=ct)
+        if self.redirect_on_read and rng is None \
+                and len(entry.chunks) == 1 \
+                and entry.chunks[0].offset == 0 \
+                and entry.chunks[0].size == size:
+            # -redirectOnRead (filer.go:50, handleSingleChunk): bounce
+            # the client straight to the volume server instead of
+            # proxying. Only when the single chunk IS the whole file —
+            # a sparse entry's raw blob would be the wrong bytes.
+            url = None
+            try:
+                url = await self.client.lookup_file_id(
+                    entry.chunks[0].file_id)
+            except (OperationError, IndexError, aiohttp.ClientError,
+                    asyncio.TimeoutError):
+                pass  # fall through to the proxy path
+            if url:
+                raise web.HTTPFound(location=url)
         resp = web.StreamResponse(status=status, headers=headers)
         resp.content_type = ct
         await resp.prepare(req)
@@ -185,7 +211,16 @@ class FilerServer:
         return resp
 
     async def _list_dir(self, req: web.Request, path: str) -> web.Response:
+        if self.disable_dir_listing:
+            # -disableDirListing (filer.go:51)
+            return web.json_response(
+                {"error": "directory listing is disabled"}, status=405)
         limit = int(req.query.get("limit", 1000))
+        if limit <= 0:
+            # SQLite treats LIMIT -1 as unlimited — a negative client
+            # value must not bypass the cap
+            limit = 1000
+        limit = min(limit, self.dir_list_limit)
         last = req.query.get("lastFileName", "")
         entries = self.filer.list_directory_entries(path, last, False, limit)
         return web.json_response({
@@ -257,7 +292,8 @@ class FilerServer:
                 if not data:
                     break
                 a = await self.client.assign(
-                    collection=collection, replication=replication, ttl=ttl)
+                    collection=collection, replication=replication,
+                    ttl=ttl, data_center=self.data_center)
                 up = await self.client.upload(a["fid"], a["url"], data,
                                               mime=mime, ttl=ttl,
                                               auth=a.get("auth", ""))
@@ -347,7 +383,8 @@ class FilerServer:
             a = await self.client.assign(
                 collection=req.query.get("collection", self.collection),
                 replication=req.query.get("replication", self.replication),
-                ttl=req.query.get("ttl", ""))
+                ttl=req.query.get("ttl", ""),
+                data_center=self.data_center)
         except OperationError as e:
             return web.json_response({"error": str(e)}, status=500)
         return web.json_response(a)

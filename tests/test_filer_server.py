@@ -155,3 +155,44 @@ def test_sparse_file_streams_zero_filled_holes(tmp_path):
                 assert resp.status == 206
                 assert await resp.read() == b"\x00" * 5 + b"B" * 5
     run(body())
+
+
+def test_filer_knobs_redirect_listing(tmp_path):
+    """-redirectOnRead / -disableDirListing / -dirListLimit
+    (command/filer.go:50-53)."""
+    async def body():
+        c = Cluster(str(tmp_path))
+        c.with_filer = True
+        async with c:
+            f = c.filer
+            async with c.http.post(f"http://{f.url}/d/one.bin",
+                                   data=b"single-chunk") as r:
+                assert r.status == 201
+            # single-chunk GET redirects straight to the volume server
+            f.redirect_on_read = True
+            async with c.http.get(f"http://{f.url}/d/one.bin",
+                                  allow_redirects=False) as resp:
+                assert resp.status == 302
+                loc = resp.headers["Location"]
+            async with c.http.get(loc) as resp:
+                assert await resp.read() == b"single-chunk"
+            # ...but range reads still proxy (the redirect would lose
+            # the filer's chunk-overlay semantics)
+            async with c.http.get(
+                    f"http://{f.url}/d/one.bin", allow_redirects=False,
+                    headers={"Range": "bytes=0-5"}) as resp:
+                assert resp.status == 206
+            f.redirect_on_read = False
+
+            # listing cap + kill switch
+            f.dir_list_limit = 1
+            async with c.http.get(f"http://{f.url}/d/",
+                                  params={"limit": "1000"}) as resp:
+                body_ = await resp.json()
+                assert len(body_["Entries"]) == 1
+            f.dir_list_limit = 100_000
+            f.disable_dir_listing = True
+            async with c.http.get(f"http://{f.url}/d/") as resp:
+                assert resp.status == 405
+            f.disable_dir_listing = False
+    run(body())
