@@ -190,10 +190,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     up = sub.add_parser("upload", help="upload files via assign+PUT")
     _add_common(up)
-    up.add_argument("files", nargs="+")
+    up.add_argument("files", nargs="*", default=[])
     up.add_argument("-collection", default="")
     up.add_argument("-replication", default="")
     up.add_argument("-ttl", default="")
+    up.add_argument("-dataCenter", default="")
+    up.add_argument("-dir", dest="updir", default="",
+                    help="upload this folder recursively (upload.go:35)")
+    up.add_argument("-include", default="",
+                    help="glob of names to upload, with -dir (e.g. *.pdf)")
     up.add_argument("-maxMB", type=int, default=0,
                     help="split files larger than this into a chunk "
                          "manifest (0 = never split)")
@@ -555,15 +560,9 @@ async def _run_filer_copy(args) -> None:
     for src in sources:
         if os.path.isdir(src):
             base = os.path.basename(os.path.abspath(src))
-            for root, _, files in os.walk(src):
-                for name in files:
-                    if args.include and not fnmatch.fnmatch(name,
-                                                            args.include):
-                        continue
-                    full = os.path.join(root, name)
-                    rel = os.path.join(base,
-                                       os.path.relpath(full, src))
-                    jobs.append((full, rel))
+            for full in _walk_upload_files(src, args.include):
+                rel = os.path.join(base, os.path.relpath(full, src))
+                jobs.append((full, rel))
         elif os.path.isfile(src):
             if not args.include or fnmatch.fnmatch(
                     os.path.basename(src), args.include):
@@ -715,12 +714,32 @@ async def _run_server(args) -> None:
                                    if srv is not None])
 
 
+def _walk_upload_files(dir_path: str, include: str) -> list[str]:
+    """Recursive -dir traversal filtered by the -include glob (shared by
+    upload and filer.copy; upload.go:35-36)."""
+    import fnmatch
+    if not os.path.isdir(dir_path):
+        raise SystemExit(f"no such directory: {dir_path}")
+    out = []
+    for root, _, names in os.walk(dir_path):
+        for name in sorted(names):
+            if include and not fnmatch.fnmatch(name, include):
+                continue
+            out.append(os.path.join(root, name))
+    return out
+
+
 async def _run_upload(args) -> None:
     from .util.client import WeedClient
     max_mb = getattr(args, "maxMB", 0) or 0
+    files = list(args.files)
+    if args.updir:
+        files.extend(_walk_upload_files(args.updir, args.include))
+    if not files:
+        raise SystemExit("upload: no input files (pass paths or -dir)")
     async with WeedClient(args.master) as c:
         out = []
-        for path in args.files:
+        for path in files:
             with open(path, "rb") as f:
                 data = f.read()
             if max_mb > 0 and len(data) > max_mb * 1024 * 1024:
@@ -729,7 +748,8 @@ async def _run_upload(args) -> None:
                 fid, cm = await upload_in_chunks(
                     c, data, max_mb, name=os.path.basename(path),
                     collection=args.collection,
-                    replication=args.replication, ttl=args.ttl)
+                    replication=args.replication, ttl=args.ttl,
+                    data_center=args.dataCenter)
                 out.append({"fileName": os.path.basename(path),
                             "fid": fid, "size": len(data),
                             "chunks": len(cm.chunks),
@@ -737,7 +757,8 @@ async def _run_upload(args) -> None:
                 continue
             fid = await c.upload_data(data, collection=args.collection,
                                       replication=args.replication,
-                                      ttl=args.ttl)
+                                      ttl=args.ttl,
+                                      data_center=args.dataCenter)
             out.append({"fileName": os.path.basename(path), "fid": fid,
                         "size": len(data),
                         "fileUrl": await c.lookup_file_id(fid)})

@@ -74,7 +74,8 @@ class ChunkManifest:
 async def upload_in_chunks(client, data: bytes, max_mb: int,
                            name: str = "", mime: str = "",
                            collection: str = "", replication: str = "",
-                           ttl: str = "") -> tuple[str, "ChunkManifest"]:
+                           ttl: str = "", data_center: str = ""
+                           ) -> tuple[str, "ChunkManifest"]:
     """Client-side auto-split (submit.go:112-199): upload ceil(n/maxMB)
     chunk needles, then the manifest needle with ?cm=true. On any chunk
     failure the already-uploaded chunks are deleted. Returns
@@ -86,10 +87,11 @@ async def upload_in_chunks(client, data: bytes, max_mb: int,
             piece = data[i:i + chunk_size]
             fid = await client.upload_data(
                 piece, collection=collection, replication=replication,
-                ttl=ttl)
+                ttl=ttl, data_center=data_center)
             cm.chunks.append(ChunkInfo(fid, i, len(piece)))
         a = await client.assign(collection=collection,
-                                replication=replication, ttl=ttl)
+                                replication=replication, ttl=ttl,
+                                data_center=data_center)
         await client.upload_manifest(a["fid"], a["url"], cm, ttl=ttl,
                                      auth=a.get("auth", ""))
         return a["fid"], cm

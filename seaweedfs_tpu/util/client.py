@@ -151,11 +151,12 @@ class WeedClient:
 
     async def upload_data(self, data: bytes, collection: str = "",
                           replication: str = "", ttl: str = "",
-                          mime: str = "") -> str:
+                          mime: str = "", data_center: str = "") -> str:
         """assign + upload (forwarding the assign's write token); returns
         the fid."""
         a = await self.assign(collection=collection,
-                              replication=replication, ttl=ttl)
+                              replication=replication, ttl=ttl,
+                              data_center=data_center)
         await self.upload(a["fid"], a["url"], data, mime=mime, ttl=ttl,
                           auth=a.get("auth", ""))
         return a["fid"]
