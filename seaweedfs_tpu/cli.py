@@ -165,6 +165,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="require SigV4 auth with this access key "
                           "(empty = anonymous)")
     s3p.add_argument("-secretKey", default="")
+    s3p.add_argument("-domainName", default="",
+                     help="enable virtual-host-style requests "
+                          "(Host: bucket.<domainName>)")
 
     wd = sub.add_parser("webdav", help="start a WebDAV gateway")
     _add_common(wd)
@@ -655,7 +658,8 @@ async def _run_s3(args) -> None:
     filer = Filer(args.store, **kwargs)
     _attach_discovered_queue(filer)
     s3 = S3Gateway(filer, args.master,
-                   ip=args.ip, port=args.port, identities=identities)
+                   ip=args.ip, port=args.port, identities=identities,
+                   domain_name=args.domainName)
     await s3.start()
     print(f"s3 gateway listening on {s3.url}")
     await _serve_until_interrupt(s3)

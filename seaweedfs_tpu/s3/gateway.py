@@ -65,7 +65,11 @@ class S3Gateway:
     def __init__(self, filer: Filer, master_url: str,
                  ip: str = "127.0.0.1", port: int = 8333,
                  chunk_size: int = 8 * 1024 * 1024,
-                 identities: dict[str, str] | None = None):
+                 identities: dict[str, str] | None = None,
+                 domain_name: str = ""):
+        # -domainName (s3api_server.go:35-37): virtual-host-style
+        # addressing, Host: <bucket>.<domainName>
+        self.domain_name = domain_name
         self.filer = filer
         self.master_url = master_url
         self.ip = ip
@@ -82,7 +86,9 @@ class S3Gateway:
     def _build_app(self) -> web.Application:
         app = web.Application(client_max_size=5 * 1024 * 1024 * 1024,
                               middlewares=[self._auth_middleware])
-        app.router.add_route("GET", "/", self.h_list_buckets)
+        # "*": with -domainName, PUT/DELETE bucket.domain/ are bucket
+        # operations that land on the root path
+        app.router.add_route("*", "/", self.h_list_buckets)
         app.router.add_route("*", "/{bucket}", self.h_bucket)
         app.router.add_route("*", "/{bucket}/{key:.+}", self.h_object)
         return app
@@ -148,7 +154,32 @@ class S3Gateway:
     # buckets
     # ------------------------------------------------------------------
 
+    def _host_bucket(self, req: web.Request) -> "str | None":
+        """Bucket named by a virtual-host-style Host header
+        (s3api_server.go:35-37), else None for path-style."""
+        if not self.domain_name:
+            return None
+        host = req.headers.get("Host", "").split(":")[0]
+        suffix = "." + self.domain_name
+        if host.endswith(suffix):
+            bucket = host[: -len(suffix)]
+            # an empty label ('Host: .domain') would alias the whole
+            # /buckets root — a single malformed header must never turn
+            # DELETE / into delete-every-bucket
+            if bucket and "/" not in bucket:
+                return bucket
+        return None
+
     async def h_list_buckets(self, req: web.Request) -> web.Response:
+        hb = self._host_bucket(req)
+        if hb is not None:
+            # bucket.domain/ is a bucket operation, not ListBuckets
+            return await self._bucket_ops(req, hb)
+        if req.method != "GET":
+            return _err("MethodNotAllowed", req.method, 405)
+        return await self._list_buckets(req)
+
+    async def _list_buckets(self, req: web.Request) -> web.Response:
         root = ET.Element("ListAllMyBucketsResult", xmlns=_NS)
         owner = ET.SubElement(root, "Owner")
         ET.SubElement(owner, "ID").text = "seaweedfs_tpu"
@@ -162,7 +193,15 @@ class S3Gateway:
         return _xml(root)
 
     async def h_bucket(self, req: web.Request) -> web.Response:
-        bucket = req.match_info["bucket"]
+        hb = self._host_bucket(req)
+        if hb is not None:
+            # host-style: the single path segment is an object key
+            return await self._object_ops(
+                req, hb, urllib.parse.unquote(req.match_info["bucket"]))
+        return await self._bucket_ops(req, req.match_info["bucket"])
+
+    async def _bucket_ops(self, req: web.Request,
+                          bucket: str) -> web.Response:
         path = f"{BUCKETS_DIR}/{bucket}"
         if req.method == "PUT":
             self.filer.create_entry(new_directory_entry(path))
@@ -342,6 +381,14 @@ class S3Gateway:
     async def h_object(self, req: web.Request) -> web.Response:
         bucket = req.match_info["bucket"]
         key = urllib.parse.unquote(req.match_info["key"])
+        hb = self._host_bucket(req)
+        if hb is not None:
+            # host-style: the first path segment belongs to the key
+            bucket, key = hb, f"{urllib.parse.unquote(bucket)}/{key}"
+        return await self._object_ops(req, bucket, key)
+
+    async def _object_ops(self, req: web.Request, bucket: str,
+                          key: str) -> web.Response:
         path = f"{BUCKETS_DIR}/{bucket}/{key}"
         q = req.query
         if "uploadId" in q or "uploads" in q:

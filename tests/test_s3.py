@@ -171,3 +171,42 @@ def test_copy_and_bulk_delete(tmp_path):
             async with c.http.get(f"{s3}/dst/copied") as r:
                 assert await r.read() == b"copy me"
     run(body())
+
+
+def test_virtual_host_style_addressing(tmp_path):
+    """-domainName: Host: <bucket>.<domain> addressing
+    (s3api_server.go:35-37)."""
+    async def body():
+        async with S3Cluster(str(tmp_path)) as c:
+            c.s3.domain_name = "s3.example.com"
+            s3 = f"http://{c.s3.url}"
+            vh = {"Host": "vbuck.s3.example.com"}
+            # PUT bucket.domain/ creates the bucket
+            async with c.http.put(f"{s3}/", headers=vh) as r:
+                assert r.status == 200
+            # object lifecycle entirely host-style, incl. a nested key
+            # whose first segment must not be mistaken for a bucket
+            async with c.http.put(f"{s3}/a/b.txt", headers=vh,
+                                  data=b"vh-bytes") as r:
+                assert r.status in (200, 201), await r.text()
+            async with c.http.get(f"{s3}/a/b.txt", headers=vh) as r:
+                assert r.status == 200
+                assert await r.read() == b"vh-bytes"
+            # path-style still works side by side
+            async with c.http.get(f"{s3}/vbuck/a/b.txt") as r:
+                assert r.status == 200
+                assert await r.read() == b"vh-bytes"
+            # host-style bucket listing sees the key
+            async with c.http.get(f"{s3}/", headers=vh,
+                                  params={"list-type": "2"}) as r:
+                assert "a/b.txt" in _tags(await r.read(), "Key")
+            # a plain Host (no domain suffix) still lists buckets
+            async with c.http.get(f"{s3}/") as r:
+                assert "vbuck" in _tags(await r.read(), "Name")
+            # host-style single-segment key (the h_bucket route)
+            async with c.http.put(f"{s3}/top.txt", headers=vh,
+                                  data=b"t") as r:
+                assert r.status in (200, 201), await r.text()
+            async with c.http.get(f"{s3}/top.txt", headers=vh) as r:
+                assert await r.read() == b"t"
+    run(body())
