@@ -21,6 +21,7 @@ from aiohttp import web
 
 from ..ec import gf
 from ..ec import pipeline as ecpl
+from ..ec.ec_volume import EcVolumeError
 from ..pb import messages as pb
 from ..util import glog
 from ..storage import types as t
@@ -88,6 +89,7 @@ class VolumeServer:
         self._runner: web.AppRunner | None = None
         self._tasks: list[asyncio.Task] = []
         self._http: aiohttp.ClientSession | None = None
+        self._hb_lock = asyncio.Lock()
         from .ec_locations import EcLocationCache
         self._ec_locations = EcLocationCache(self._lookup_ec_locations)
         self.app = self._build_app()
@@ -248,37 +250,46 @@ class VolumeServer:
         self.store.new_ec_shards.extend(hb.new_ec_shards)
         self.store.deleted_ec_shards.extend(hb.deleted_ec_shards)
 
-    async def heartbeat_once(self) -> None:
-        from ..stats import metrics
-        if metrics.HAVE_PROMETHEUS:
-            metrics.VOLUME_COUNT.set(len(self.store.volumes))
-        hb = self.store.collect_heartbeat(self.data_center, self.rack)
-        try:
-            async with self._http.post(
-                    tls.url(self.master_url, "/cluster/heartbeat"),
-                    json=hb.to_dict()) as resp:
-                body = await resp.json()
-        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
-            self._requeue_deltas(hb)
-            raise
-        leader = body.get("leader")
-        if body.get("rejected"):
-            # a follower master refused registration: requeue deltas and
-            # chase the leader it pointed at
-            self._requeue_deltas(hb)
-            if leader:
+    async def heartbeat_once(self) -> bool:
+        """Returns True when the (leader) master accepted the state;
+        False when a follower redirected us (deltas requeued, master_url
+        now points at the leader). Serialized: a stale full-state
+        snapshot posted concurrently could land AFTER a newer one and
+        un-register just-mounted shards (register_heartbeat replaces the
+        node's state wholesale)."""
+        async with self._hb_lock:
+            from ..stats import metrics
+            if metrics.HAVE_PROMETHEUS:
+                metrics.VOLUME_COUNT.set(len(self.store.volumes))
+            hb = self.store.collect_heartbeat(self.data_center, self.rack)
+            try:
+                async with self._http.post(
+                        tls.url(self.master_url, "/cluster/heartbeat"),
+                        json=hb.to_dict()) as resp:
+                    body = await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                self._requeue_deltas(hb)
+                raise
+            leader = body.get("leader")
+            if body.get("rejected"):
+                # a follower master refused registration: requeue deltas
+                # and chase the leader it pointed at
+                self._requeue_deltas(hb)
+                if leader:
+                    self.master_url = leader
+                    return False
+                # rejected with no leader known: treat as failure so the
+                # heartbeat loop rotates to another seed master
+                raise OSError(
+                    f"master {self.master_url} rejected heartbeat, "
+                    f"no leader")
+            self.volume_size_limit = body.get(
+                "volume_size_limit", self.volume_size_limit)
+            if leader and leader != self.master_url:
+                glog.info("volume %s: chasing new master leader %s "
+                          "(was %s)", self.url, leader, self.master_url)
                 self.master_url = leader
-                return
-            # rejected with no leader known: treat as failure so the
-            # heartbeat loop rotates to another seed master
-            raise OSError(
-                f"master {self.master_url} rejected heartbeat, no leader")
-        self.volume_size_limit = body.get(
-            "volume_size_limit", self.volume_size_limit)
-        if leader and leader != self.master_url:
-            glog.info("volume %s: chasing new master leader %s (was %s)",
-                      self.url, leader, self.master_url)
-            self.master_url = leader
+            return True
 
     async def _heartbeat_loop(self) -> None:
         while True:
@@ -339,9 +350,11 @@ class VolumeServer:
             return web.Response(status=404)
         except CrcMismatch as e:
             return web.json_response({"error": str(e)}, status=500)
-        except BackendError as e:
-            # tiered volume whose remote tier is unreachable: surface a
-            # clean 503 instead of an unhandled traceback
+        except (EcVolumeError, BackendError) as e:
+            # retryable server-side degradation: an EC read that could
+            # not gather enough shards (remote holders unreachable /
+            # registry not yet synced) or a tiered volume whose remote
+            # tier is down — clean 503, never a traceback
             if metrics.HAVE_PROMETHEUS:
                 metrics.VOLUME_REQUEST_COUNTER.labels("read", "error").inc()
             return web.json_response({"error": str(e)}, status=503)
@@ -859,6 +872,9 @@ class VolumeServer:
         except VolumeError as e:
             # a delete that found nothing must not report success
             return web.json_response({"error": str(e)}, status=404)
+        # the master must drop this location before the next pulse, or
+        # lookups keep routing reads at a volume that no longer exists
+        await self._heartbeat_now()
         return web.json_response({"ok": True})
 
     async def h_readonly(self, req: web.Request) -> web.Response:
@@ -875,10 +891,12 @@ class VolumeServer:
                 None, lambda: self.store.mount_volume(collection, vid))
         except VolumeError as e:
             return web.json_response({"error": str(e)}, status=404)
+        await self._heartbeat_now()
         return web.json_response({"ok": True})
 
     async def h_volume_unmount(self, req: web.Request) -> web.Response:
         self.store.unmount_volume(int(req.query["volume"]))
+        await self._heartbeat_now()
         return web.json_response({"ok": True})
 
     async def h_volume_copy(self, req: web.Request) -> web.Response:
@@ -1160,13 +1178,29 @@ class VolumeServer:
             shards = self.store.mount_ec_shards(collection, vid)
         except VolumeError as e:
             return web.json_response({"error": str(e)}, status=404)
+        # push the registration NOW, not at the next pulse: a read that
+        # lands anywhere in the cluster within the pulse window needs
+        # the master to know these shard locations, or reconstruction
+        # fails with too few sources (the reference's delta heartbeat
+        # channel, volume_grpc_client_to_master.go:120-177)
+        await self._heartbeat_now()
         return web.json_response({"shards": shards})
+
+    async def _heartbeat_now(self) -> None:
+        try:
+            if not await self.heartbeat_once():
+                # a follower redirected us: the LEADER must learn the
+                # new state now, not at the next pulse
+                await self.heartbeat_once()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            glog.warning("immediate heartbeat failed: %s", e)
 
     async def h_ec_unmount(self, req: web.Request) -> web.Response:
         vid = int(req.query["volume"])
         ids = req.query.get("shards", "")
         shard_ids = [int(x) for x in ids.split(",") if x] if ids else None
         self.store.unmount_ec_shards(vid, shard_ids)
+        await self._heartbeat_now()
         return web.json_response({"ok": True})
 
     async def h_ec_copy(self, req: web.Request) -> web.Response:

@@ -172,6 +172,11 @@ class WeedClient:
             if resp.status in (404, 410):
                 raise OperationError(f"read {fid}: not found")
             data = await resp.read()
+            if resp.status >= 400:
+                # an error body must never masquerade as file content
+                raise OperationError(
+                    f"read {fid}: http {resp.status} "
+                    f"{data[:200].decode(errors='replace')}")
         if resp.status == 200 and (offset or size >= 0):
             # server ignored Range; slice locally
             data = data[offset:offset + size if size >= 0 else None]

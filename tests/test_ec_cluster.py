@@ -43,9 +43,12 @@ def test_ec_encode_spread_read_rebuild_balance(tmp_path):
                 assert len(assignments) == 4
                 assert sum(len(s) for s in assignments.values()) == 14
 
-            await c.heartbeat_all()
-            # original volumes gone; reads now go through EC paths,
-            # including cross-server remote shard fetch
+            # NO heartbeat_all here: ec mount/unmount/delete push
+            # immediate delta heartbeats, so reads that land ANYWHERE in
+            # the cluster right after ec.encode must already succeed —
+            # waiting a pulse used to hide a window where remote-shard
+            # lookups found nothing and reconstruction failed with too
+            # few sources (the round-4 soak's 783-bad-read bug)
             for vs in c.servers:
                 assert not any(int(v.split(",")[0]) in vs.store.volumes
                                for v, _, _ in files)
