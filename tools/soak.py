@@ -1,0 +1,263 @@
+"""Cross-feature chaos soaks against a real CLI cluster.
+
+These are the round-4 scenarios that found the post-ec.encode
+stale-registry bug (ROUND4.md "Session-2 soak results") — kept runnable
+so regressions in the distributed plane surface again. Each scenario
+starts its own master/volume processes on private ports, drives load
+over real sockets, and byte-verifies every surviving file at the end.
+
+    python tools/soak.py ec            # write/delete/vacuum/ec.encode/verify
+    python tools/soak.py vacuum-race   # writers+deletes racing vacuum rounds
+    python tools/soak.py rebuild       # encode, SIGKILL a shard holder, rebuild
+    python tools/soak.py all
+
+Exit code 0 only when every read verifies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASE_PORT = 21500
+
+
+class Procs:
+    def __init__(self, tmp: str):
+        self.tmp = tmp
+        self.procs: list[subprocess.Popen] = []
+        self.env = dict(os.environ, JAX_PLATFORMS="cpu",
+                        PYTHONPATH=REPO)
+
+    def spawn(self, *args: str) -> subprocess.Popen:
+        log = open(os.path.join(
+            self.tmp, f"proc{len(self.procs)}.log"), "w")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", *args],
+            stdout=log, stderr=subprocess.STDOUT, env=self.env, cwd=REPO)
+        self.procs.append(p)
+        return p
+
+    def shell(self, master: str, cmd: str) -> str:
+        # timeout: a shell command wedged on a dead server must fail
+        # the scenario, not hang the soak forever
+        out = subprocess.run(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", "shell",
+             "-master", master, "-c", cmd],
+            capture_output=True, text=True, env=self.env, cwd=REPO,
+            timeout=180)
+        return out.stdout + out.stderr
+
+    def kill_all(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in self.procs:
+            p.wait(timeout=10)
+
+
+def wait_assign(master: str, params: str = "", tries: int = 30) -> None:
+    for _ in range(tries):
+        try:
+            with urllib.request.urlopen(
+                    f"http://{master}/dir/assign?{params}",
+                    timeout=3) as r:
+                if b"fid" in r.read():
+                    return
+        except OSError:
+            pass
+        time.sleep(1)
+    raise RuntimeError("cluster never became assignable")
+
+
+async def fill(client, payloads: dict, n: int, rng,
+               replication: str = "001") -> None:
+    sem = asyncio.Semaphore(24)
+
+    async def put(i):
+        data = rng.randbytes(rng.randint(500, 30000))
+        async with sem:
+            fid = await client.upload_data(data, replication=replication)
+        payloads[fid] = data
+
+    await asyncio.gather(*(put(i) for i in range(n)))
+
+
+async def verify(client, payloads: dict, tag: str) -> int:
+    sem = asyncio.Semaphore(24)
+    bad = []
+
+    async def check(fid, want):
+        async with sem:
+            try:
+                got = await client.read(fid)
+            except Exception as e:  # noqa: BLE001 — every failure counts
+                bad.append((fid, f"ERR {type(e).__name__} "
+                                 f"{str(e)[:80]}"))
+                return
+        if got != want:
+            bad.append((fid, f"MISMATCH {len(got)} vs {len(want)}"))
+
+    await asyncio.gather(*(check(f, w) for f, w in payloads.items()))
+    print(f"  {tag}: bad={len(bad)}/{len(payloads)}")
+    for fid, why in bad[:5]:
+        print("   ", fid, why)
+    return len(bad)
+
+
+def cluster(procs: Procs, port0: int, n_servers: int,
+            master_args: tuple[str, ...] = ()) -> str:
+    master = f"127.0.0.1:{port0}"
+    procs.spawn("master", "-port", str(port0),
+                "-mdir", os.path.join(procs.tmp, "m"),
+                "-volumeSizeLimitMB", "8", "-pulseSeconds", "1",
+                *master_args)
+    time.sleep(2)
+    for i in range(n_servers):
+        procs.spawn("volume", "-port", str(port0 + 1 + i),
+                    "-dir", os.path.join(procs.tmp, f"v{i}"),
+                    "-max", "20", "-master", master,
+                    "-pulseSeconds", "1")
+    return master
+
+
+async def scenario_ec(tmp: str) -> int:
+    from seaweedfs_tpu.util.client import WeedClient
+    procs = Procs(tmp)
+    try:
+        master = cluster(procs, BASE_PORT, 3)
+        wait_assign(master, "replication=001")
+        rng = random.Random(42)
+        payloads: dict = {}
+        async with WeedClient(master) as c:
+            await fill(c, payloads, 1500, rng)
+            dead = rng.sample(sorted(payloads), 450)
+            await c.delete_fids(dead)
+            for f in dead:
+                del payloads[f]
+            await asyncio.to_thread(
+                procs.shell, master,
+                "volume.vacuum -garbageThreshold 0.05")
+            await asyncio.to_thread(
+                procs.shell, master, "ec.encode -fullPercent 1")
+            # NO settling sleep: reads must verify IMMEDIATELY
+            return await verify(c, payloads, "after ec.encode")
+    finally:
+        procs.kill_all()
+
+
+async def scenario_vacuum_race(tmp: str) -> int:
+    from seaweedfs_tpu.util.client import WeedClient
+    procs = Procs(tmp)
+    try:
+        master = cluster(procs, BASE_PORT + 10, 2)
+        wait_assign(master)
+        rng = random.Random(9)
+        payloads: dict = {}
+        stop = asyncio.Event()
+        async with WeedClient(master) as c:
+            async def writer():
+                i = 0
+                while not stop.is_set():
+                    i += 1
+                    data = rng.randbytes(rng.randint(500, 20000))
+                    try:
+                        fid = await c.upload_data(data,
+                                                  replication="001")
+                    except Exception:  # noqa: BLE001
+                        await asyncio.sleep(0.05)
+                        continue
+                    payloads[fid] = data
+                    if i % 4 == 0 and payloads:
+                        victim = rng.choice(list(payloads))
+                        try:
+                            await c.delete_fids([victim])
+                            del payloads[victim]
+                        except Exception:  # noqa: BLE001
+                            pass
+
+            writers = [asyncio.create_task(writer()) for _ in range(8)]
+            for round_ in range(4):
+                await asyncio.sleep(4)
+                # to_thread: a blocking subprocess.run would suspend the
+                # writers and erase the very race being tested
+                await asyncio.to_thread(
+                    procs.shell, master,
+                    "volume.vacuum -garbageThreshold 0.01")
+                print(f"  vacuum round {round_ + 1} "
+                      f"({len(payloads)} live)")
+            stop.set()
+            await asyncio.gather(*writers, return_exceptions=True)
+            return await verify(c, payloads, "after vacuum races")
+    finally:
+        procs.kill_all()
+
+
+async def scenario_rebuild(tmp: str) -> int:
+    from seaweedfs_tpu.util.client import WeedClient
+    procs = Procs(tmp)
+    try:
+        master = cluster(procs, BASE_PORT + 20, 4)
+        wait_assign(master)
+        rng = random.Random(12)
+        payloads: dict = {}
+        async with WeedClient(master) as c:
+            await fill(c, payloads, 900, rng, replication="000")
+            await asyncio.sleep(2)
+            # fullPercent 1: EVERY volume (incl. small tails) must be
+            # EC-protected, or the killed server takes replication-000
+            # files with it and the scenario fails on placement luck
+            await asyncio.to_thread(
+                procs.shell, master, "ec.encode -fullPercent 1")
+            bad = await verify(c, payloads, "after encode")
+            # SIGKILL one shard-holding volume server (procs[2])
+            procs.procs[2].send_signal(signal.SIGKILL)
+            await asyncio.sleep(4)
+            bad += await verify(c, payloads, "degraded (server killed)")
+            await asyncio.to_thread(
+                procs.shell, master, "ec.rebuild -force")
+            await asyncio.sleep(2)
+            bad += await verify(c, payloads, "after ec.rebuild")
+            return bad
+    finally:
+        procs.kill_all()
+
+
+SCENARIOS = {
+    "ec": scenario_ec,
+    "vacuum-race": scenario_vacuum_race,
+    "rebuild": scenario_rebuild,
+}
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which != "all" and which not in SCENARIOS:
+        raise SystemExit(f"unknown scenario {which!r}; "
+                         f"choose from: all, {', '.join(SCENARIOS)}")
+    names = list(SCENARIOS) if which == "all" else [which]
+    total_bad = 0
+    for name in names:
+        print(f"== soak: {name}")
+        tmp = tempfile.mkdtemp(prefix=f"swtpu_soak_{name}_")
+        try:
+            total_bad += asyncio.run(SCENARIOS[name](tmp))
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    print("PASS" if total_bad == 0 else f"FAIL ({total_bad} bad reads)")
+    sys.exit(0 if total_bad == 0 else 1)
+
+
+if __name__ == "__main__":
+    main()
