@@ -9,6 +9,7 @@ over real sockets, and byte-verifies every surviving file at the end.
     python tools/soak.py ec            # write/delete/vacuum/ec.encode/verify
     python tools/soak.py vacuum-race   # writers+deletes racing vacuum rounds
     python tools/soak.py rebuild       # encode, SIGKILL a shard holder, rebuild
+    python tools/soak.py failover      # SIGKILL the leader master under load
     python tools/soak.py all
 
 Exit code 0 only when every read verifies.
@@ -234,10 +235,76 @@ async def scenario_rebuild(tmp: str) -> int:
         procs.kill_all()
 
 
+async def scenario_failover(tmp: str) -> int:
+    import json
+
+    from seaweedfs_tpu.util.client import WeedClient
+    procs = Procs(tmp)
+    try:
+        port0 = BASE_PORT + 30
+        peers = ",".join(f"127.0.0.1:{port0 + i}" for i in range(3))
+        for i in range(3):
+            procs.spawn("master", "-port", str(port0 + i),
+                        "-mdir", os.path.join(procs.tmp, f"m{i}"),
+                        "-peers", peers, "-pulseSeconds", "1",
+                        "-sequencer",
+                        f"file:{os.path.join(procs.tmp, f'seq{i}')}")
+        time.sleep(4)
+        for i in range(2):
+            procs.spawn("volume", "-port", str(port0 + 10 + i),
+                        "-dir", os.path.join(procs.tmp, f"v{i}"),
+                        "-max", "16", "-master", peers,
+                        "-pulseSeconds", "1")
+        follower = f"127.0.0.1:{port0}"
+        wait_assign(follower, "replication=001")
+        with urllib.request.urlopen(
+                f"http://{follower}/cluster/status", timeout=5) as r:
+            leader = json.load(r)["leader"]
+        leader_proc = procs.procs[int(leader.split(":")[1]) - port0]
+
+        rng = random.Random(3)
+        payloads: dict = {}
+        errors = []
+        stop = asyncio.Event()
+        async with WeedClient(follower) as c:
+            async def writer():
+                while not stop.is_set():
+                    data = rng.randbytes(rng.randint(500, 8000))
+                    try:
+                        fid = await c.upload_data(data,
+                                                  replication="001")
+                        payloads[fid] = data
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(str(e)[:60])
+                        await asyncio.sleep(0.2)
+
+            writers = [asyncio.create_task(writer()) for _ in range(6)]
+            await asyncio.sleep(5)
+            pre = len(payloads)
+            leader_proc.send_signal(signal.SIGKILL)
+            t_kill = time.time()
+            while len(payloads) <= pre and time.time() - t_kill < 60:
+                await asyncio.sleep(0.5)
+            recovery = time.time() - t_kill
+            print(f"  first post-kill write after {recovery:.1f}s "
+                  f"({len(errors)} transient errors)")
+            await asyncio.sleep(8)
+            stop.set()
+            await asyncio.gather(*writers, return_exceptions=True)
+            bad = await verify(c, payloads, "after leader failover")
+            if recovery >= 60:
+                print("  FAIL: no write succeeded within 60s of the kill")
+                bad += 1
+            return bad
+    finally:
+        procs.kill_all()
+
+
 SCENARIOS = {
     "ec": scenario_ec,
     "vacuum-race": scenario_vacuum_race,
     "rebuild": scenario_rebuild,
+    "failover": scenario_failover,
 }
 
 
