@@ -136,6 +136,10 @@ def build_parser() -> argparse.ArgumentParser:
     fc.add_argument("-concurrency", type=int, default=8)
     fc.add_argument("-include", default="",
                     help="only copy names matching this glob (e.g. *.txt)")
+    fc.add_argument("-collection", default="")
+    fc.add_argument("-replication", default="")
+    fc.add_argument("-ttl", default="",
+                    help="time to live, e.g. 1m, 1h, 1d")
 
     fr = sub.add_parser("filer.replicate",
                         help="replay filer meta events into a sink "
@@ -577,6 +581,10 @@ async def _run_filer_copy(args) -> None:
 
     sem = asyncio.Semaphore(args.concurrency)
     copied = errors = 0
+    attr_params = {k: v for k, v in (
+        ("collection", args.collection),
+        ("replication", args.replication),
+        ("ttl", args.ttl)) if v}
 
     async with tls.make_session() as http:
         async def upload(local: str, rel: str) -> bool:
@@ -590,7 +598,8 @@ async def _run_filer_copy(args) -> None:
                                        filename=os.path.basename(rel))
                         target = dest + urllib.parse.quote(
                             rel.replace(os.sep, "/"))
-                        async with http.post(target, data=form) as resp:
+                        async with http.post(target, data=form,
+                                             params=attr_params) as resp:
                             if resp.status not in (200, 201):
                                 print(f"copy {local}: http {resp.status} "
                                       f"{await resp.text()}")

@@ -18,6 +18,7 @@ from ..filer.entry import Attr, Entry
 from ..filer.filechunks import (FileChunk, etag as chunks_etag, total_size)
 from ..filer.filer import Filer, FilerError
 from ..filer.stream import stream_chunk_views
+from ..storage import types as t
 from ..util.client import OperationError, WeedClient
 from ..util.httprange import RangeError, parse_range
 from ..security import tls
@@ -284,6 +285,13 @@ class FilerServer:
         collection = req.query.get("collection", self.collection)
         replication = req.query.get("replication", self.replication)
         ttl = req.query.get("ttl", "")
+        try:
+            # validate BEFORE uploading any chunk: a bad ttl must be an
+            # early 400, not a post-upload 500 with chunk rollback (or a
+            # silent drop on a zero-byte file)
+            ttl_sec = t.TTL.parse(ttl).minutes * 60
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
         chunks: list[FileChunk] = []
         offset = 0
         try:
@@ -313,7 +321,7 @@ class FilerServer:
             full_path=path,
             attr=Attr(mtime=now, crtime=now, mode=0o660, mime=mime,
                       replication=replication, collection=collection,
-                      ttl_sec=0),
+                      ttl_sec=ttl_sec),
             chunks=chunks)
         try:
             self.filer.create_entry(entry)

@@ -52,6 +52,9 @@ def test_filer_copy_tree(tmp_path):
             paths = [str(src), None]
             concurrency = 4
             include = "*.txt"
+            collection = ""
+            replication = ""
+            ttl = ""
 
         c = Cluster(str(tmp_path / "cluster"))
         c.with_filer = True
