@@ -11,6 +11,7 @@ import glob
 import os
 import re
 import threading
+import time
 
 from ..ec import gf
 from ..ec.ec_volume import EcVolume, NotFoundError as EcNotFound
@@ -215,7 +216,15 @@ class Store:
                     cookie: int | None = None) -> Needle:
         v = self.volumes.get(vid)
         if v is not None:
-            return v.read_needle(needle_id, cookie)
+            try:
+                return v.read_needle(needle_id, cookie)
+            except OSError:
+                if vid not in self.volumes:
+                    # the volume was destroyed mid-read (TTL
+                    # reclamation / admin delete): a clean 404, not a
+                    # bad-file-descriptor 500
+                    raise NotFound(f"volume {vid} was removed")
+                raise
         ev = self.ec_volumes.get(vid)
         if ev is not None:
             try:
@@ -302,10 +311,43 @@ class Store:
             version=v.version, ttl=v.ttl.to_uint32(),
             compact_revision=v.super_block.compaction_revision)
 
+    # minutes an expired TTL volume lingers before its files are
+    # destroyed (store.go MAX_TTL_VOLUME_REMOVAL_DELAY); actual delay is
+    # min(this, ttl/10) like the reference's expiredLongEnough
+    MAX_TTL_REMOVAL_DELAY_M = 10.0
+
+    def _ttl_lived_minutes(self, v) -> float | None:
+        """Minutes past this TTL volume's expiry, or None when the
+        volume has no TTL / no content yet (volume.go expired())."""
+        ttl_m = v.ttl.minutes
+        if not ttl_m or not v.last_modified_ts or v.data_size() <= 8:
+            return None
+        lived_m = (time.time() - v.last_modified_ts) / 60
+        return lived_m - ttl_m if lived_m > ttl_m else None
+
     def collect_heartbeat(self, data_center: str = "",
                           rack: str = "") -> pb.Heartbeat:
         with self._lock:
-            volumes = [self._volume_message(v) for v in self.volumes.values()]
+            # TTL volume reclamation rides the heartbeat walk like the
+            # reference (store.go:165-200): an expired volume stops
+            # being advertised immediately and its files are destroyed
+            # once it has lingered past the removal delay
+            expired_now: list[int] = []
+            active = {}
+            for vid, v in self.volumes.items():
+                over_m = self._ttl_lived_minutes(v)
+                if over_m is None:
+                    active[vid] = v
+                elif over_m > min(self.MAX_TTL_REMOVAL_DELAY_M,
+                                  v.ttl.minutes / 10):
+                    expired_now.append(vid)
+                # else: expired but within the grace window — drop from
+                # the advertised set, keep the files for now
+            for vid in expired_now:
+                v = self.volumes.pop(vid)
+                self.deleted_volumes.append(self._volume_message(v))
+                v.destroy()
+            volumes = [self._volume_message(v) for v in active.values()]
             ec_msgs = []
             for vid, ev in self.ec_volumes.items():
                 bits = 0

@@ -221,6 +221,11 @@ class Volume:
         # restore the incremental-sync watermark (volume_backup.go relies
         # on lastAppendAtNs surviving restarts)
         self.last_append_at_ns = n.append_at_ns
+        # ...and the modified watermark, or TTL volume reclamation
+        # (store.go expired()) goes dead after a restart
+        modified = n.last_modified or n.append_at_ns // 1_000_000_000
+        if modified > self.last_modified_ts:
+            self.last_modified_ts = modified
         if expected_end < size:
             # torn write past the last logged record: truncate it away
             self._dat.truncate(expected_end)
@@ -279,8 +284,9 @@ class Volume:
             self.last_append_at_ns = n.append_at_ns
             if nv is None or nv.offset < offset:
                 self.nm.put(n.id, offset, n.size)
-            if n.last_modified > self.last_modified_ts:
-                self.last_modified_ts = n.last_modified
+            modified = n.last_modified or n.append_at_ns // 1_000_000_000
+            if modified > self.last_modified_ts:
+                self.last_modified_ts = modified
             return offset, n.size
 
     def delete_needle(self, n: Needle) -> int:

@@ -277,3 +277,65 @@ def test_preallocate_keeps_append_offsets(tmp_path):
     off2, _ = v2.write_needle(Needle(cookie=6, id=2, data=b"y"))
     assert off < off2 < 8192
     v2.close()
+
+
+def test_ttl_volume_expiry_reclaims(tmp_path):
+    """Whole-volume TTL reclamation rides the heartbeat walk
+    (store.go:165-200 + volume.go expired/expiredLongEnough)."""
+    import os
+    import time as _time
+
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    st = Store([str(tmp_path)])
+    v = st.add_volume(5, ttl="1m")
+    v.write_needle(Needle(cookie=1, id=1, data=b"short-lived"))
+    hb = st.collect_heartbeat()
+    assert [m.id for m in hb.volumes] == [5]
+
+    # age past ttl but inside the grace window: no longer advertised,
+    # files still on disk
+    v.last_modified_ts = _time.time() - 63  # just past the 1m ttl
+    hb = st.collect_heartbeat()
+    assert hb.volumes == []
+    assert os.path.exists(os.path.join(str(tmp_path), "5.dat"))
+    assert 5 in st.volumes
+
+    # age past ttl + removal delay: destroyed and reported deleted
+    v.last_modified_ts = _time.time() - 3600
+    hb = st.collect_heartbeat()
+    assert hb.volumes == []
+    assert [m.id for m in hb.deleted_volumes] == [5]
+    assert 5 not in st.volumes
+    assert not os.path.exists(os.path.join(str(tmp_path), "5.dat"))
+
+    # non-TTL volumes are never reclaimed
+    v2 = st.add_volume(6)
+    v2.write_needle(Needle(cookie=1, id=1, data=b"eternal"))
+    v2.last_modified_ts = _time.time() - 10_000_000
+    hb = st.collect_heartbeat()
+    assert [m.id for m in hb.volumes] == [6]
+    st.close()
+
+
+def test_ttl_watermark_survives_restart(tmp_path):
+    """last_modified_ts must be restored on load, or TTL reclamation
+    (store.go expired()) goes dead after a volume-server restart."""
+    import time as _time
+
+    from seaweedfs_tpu.storage.needle import (FLAG_HAS_LAST_MODIFIED,
+                                              Needle)
+    from seaweedfs_tpu.storage.volume import Volume
+
+    v = Volume(str(tmp_path), "", 9)
+    n = Needle(cookie=1, id=1, data=b"x",
+               last_modified=int(_time.time()))
+    n.set_flag(FLAG_HAS_LAST_MODIFIED)
+    v.write_needle(n)
+    wm = v.last_modified_ts
+    assert wm > 0
+    v.close()
+    v2 = Volume(str(tmp_path), "", 9, create_if_missing=False)
+    assert v2.last_modified_ts == wm
+    v2.close()
