@@ -42,11 +42,26 @@ class Interval:
         return self.block_index % DATA_SHARDS, off
 
 
+def n_large_block_rows(large_block: int, dat_size: int) -> int:
+    """Number of full large rows the ENCODER writes — the
+    strictly-greater loop at ec_encoder.go:208 (`for remaining >
+    largeRow`), so an exact large-row multiple is laid out entirely as
+    small rows. The reference's READ side uses two different formulas
+    (dat_size/row at ec_locate.go:52, and a +10*small adjustment at
+    :15) that disagree with its own encoder when dat_size falls within
+    10*small below (or exactly at) a large-row multiple — reads in that
+    window resolve to wrong shard offsets. Every path here shares the
+    encoder's count instead."""
+    if dat_size <= 0:
+        return 0
+    return (dat_size - 1) // (large_block * DATA_SHARDS)
+
+
 def locate_offset(large_block: int, small_block: int, dat_size: int,
                   offset: int) -> tuple[int, bool, int]:
     """-> (block_index, is_large_block, inner_offset) — ec_locate.go:50-66."""
     large_row = large_block * DATA_SHARDS
-    n_large_rows = dat_size // large_row
+    n_large_rows = n_large_block_rows(large_block, dat_size)
     if offset < n_large_rows * large_row:
         return offset // large_block, True, offset % large_block
     offset -= n_large_rows * large_row
@@ -58,9 +73,7 @@ def locate_data(large_block: int, small_block: int, dat_size: int,
     """Split (offset, size) into per-block intervals — ec_locate.go:11-48."""
     block_index, is_large, inner = locate_offset(
         large_block, small_block, dat_size, offset)
-    # +10*small ensures the large-row count is derivable from a shard size
-    n_large_rows = (dat_size + DATA_SHARDS * small_block) // (
-        large_block * DATA_SHARDS)
+    n_large_rows = n_large_block_rows(large_block, dat_size)
     out: list[Interval] = []
     while size > 0:
         block_len = large_block if is_large else small_block
@@ -87,10 +100,7 @@ def shard_file_size(dat_size: int, large_block: int = LARGE_BLOCK_SIZE,
     """
     large_row = large_block * DATA_SHARDS
     small_row = small_block * DATA_SHARDS
-    n_large_rows = 0
-    remaining = dat_size
-    while remaining > large_row:
-        n_large_rows += 1
-        remaining -= large_row
+    n_large_rows = n_large_block_rows(large_block, dat_size)
+    remaining = dat_size - n_large_rows * large_row
     n_small_rows = -(-remaining // small_row) if remaining > 0 else 0
     return n_large_rows * large_block + n_small_rows * small_block
