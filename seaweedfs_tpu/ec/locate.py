@@ -42,6 +42,18 @@ class Interval:
         return self.block_index % DATA_SHARDS, off
 
 
+def check_blocks(large_block: int, small_block: int) -> None:
+    """Geometry guard: the padded dat size reconstructed from a shard
+    file (10 * shard_size) only lands in the same large-row count as the
+    true size when large_block is a whole number of small blocks —
+    reject configurations where reads could resolve wrong offsets."""
+    if large_block <= 0 or small_block <= 0 \
+            or large_block % small_block:
+        raise ValueError(
+            f"EC geometry: large block ({large_block}) must be a "
+            f"positive multiple of the small block ({small_block})")
+
+
 def n_large_block_rows(large_block: int, dat_size: int) -> int:
     """Number of full large rows the ENCODER writes — the
     strictly-greater loop at ec_encoder.go:208 (`for remaining >

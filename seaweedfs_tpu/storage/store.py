@@ -45,6 +45,8 @@ class Store:
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
         self.max_volume_counts = max_volume_counts or [8] * len(dirs)
+        from ..ec.locate import check_blocks
+        check_blocks(ec_large_block, ec_small_block)
         self.ec_large_block = ec_large_block
         self.ec_small_block = ec_small_block
         self.volumes: dict[int, Volume] = {}
