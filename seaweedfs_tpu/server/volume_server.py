@@ -75,8 +75,8 @@ class VolumeServer:
         self.store = store
         # comma-separated seed list: chase the leader hint, rotate seeds on
         # total failure (volume_grpc_client_to_master.go:33-53)
-        self.master_seeds = [m.strip() for m in master_url.split(",")
-                             if m.strip()]
+        from ..util.client import parse_master_seeds
+        self.master_seeds = parse_master_seeds(master_url)
         self.master_url = self.master_seeds[0]
         self._seed_idx = 0
         self.ip = ip

@@ -19,12 +19,25 @@ class OperationError(Exception):
     pass
 
 
+def parse_master_seeds(master_url: str) -> list[str]:
+    """Comma-separated master seed list (shared with the volume
+    server's heartbeat client)."""
+    return [m.strip() for m in master_url.split(",") if m.strip()]
+
+
 class WeedClient:
     def __init__(self, master_url: str,
                  session: aiohttp.ClientSession | None = None,
                  lookup_cache_ttl: float = 600.0,
                  jwt_key: str = ""):
-        self.master_url = master_url
+        # comma-separated seed list: like the reference's wdclient, a
+        # dead master must not strand the client — master requests
+        # rotate through the surviving seeds (masterclient.go:45-119)
+        self.master_seeds = parse_master_seeds(master_url)
+        # empty input keeps the raw string and fails on first use, like
+        # the pre-seed-list behavior
+        self.master_url = (self.master_seeds[0] if self.master_seeds
+                           else master_url)
         self._session = session
         self._own = session is None
         self._vid_cache: dict[str, tuple[float, list[dict]]] = {}
@@ -63,12 +76,42 @@ class WeedClient:
             params["ttl"] = ttl
         if data_center:
             params["dataCenter"] = data_center
-        async with self.http.get(tls.url(self.master_url, "/dir/assign"),
-                                 params=params) as resp:
-            body = await resp.json()
+        body = await self._master_get("/dir/assign", params)
         if "error" in body:
             raise OperationError(f"assign: {body['error']}")
         return body
+
+    async def _master_get(self, path: str, params: dict) -> dict:
+        """GET against the current master, rotating through the seed
+        list when the master is unreachable (a killed leader must not
+        strand single-seed-configured clients mid-failover)."""
+        last: object = None
+        for _ in range(max(1, len(self.master_seeds))):
+            try:
+                async with self.http.get(
+                        tls.url(self.master_url, path),
+                        params=params) as resp:
+                    body = await resp.json()
+                    if resp.status in (502, 503):
+                        # reachable follower proxying a dead leader /
+                        # no leader yet: the NEXT seed may already be
+                        # the new leader
+                        last = body.get("error", f"http {resp.status}")
+                        self._rotate_seed()
+                        continue
+                    return body
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError) as e:
+                last = e
+                self._rotate_seed()
+        raise OperationError(f"master unreachable: {last}")
+
+    def _rotate_seed(self) -> None:
+        if len(self.master_seeds) > 1:
+            i = (self.master_seeds.index(self.master_url)
+                 if self.master_url in self.master_seeds else -1)
+            self.master_url = self.master_seeds[
+                (i + 1) % len(self.master_seeds)]
 
     def attach_master_client(self, mc) -> None:
         """Route lookups through a watch-fed MasterClient
@@ -91,9 +134,7 @@ class WeedClient:
         now = time.time()
         if hit and now - hit[0] < self._cache_ttl:
             return hit[1]
-        async with self.http.get(tls.url(self.master_url, "/dir/lookup"),
-                                 params={"volumeId": vid}) as resp:
-            body = await resp.json()
+        body = await self._master_get("/dir/lookup", {"volumeId": vid})
         if "locations" not in body:
             raise OperationError(f"lookup {vid}: {body.get('error')}")
         self._vid_cache[vid] = (now, body["locations"])

@@ -255,10 +255,10 @@ async def scenario_failover(tmp: str) -> int:
                         "-dir", os.path.join(procs.tmp, f"v{i}"),
                         "-max", "16", "-master", peers,
                         "-pulseSeconds", "1")
-        follower = f"127.0.0.1:{port0}"
-        wait_assign(follower, "replication=001")
+        first = f"127.0.0.1:{port0}"
+        wait_assign(first, "replication=001")
         with urllib.request.urlopen(
-                f"http://{follower}/cluster/status", timeout=5) as r:
+                f"http://{first}/cluster/status", timeout=5) as r:
             leader = json.load(r)["leader"]
         leader_proc = procs.procs[int(leader.split(":")[1]) - port0]
 
@@ -266,7 +266,10 @@ async def scenario_failover(tmp: str) -> int:
         payloads: dict = {}
         errors = []
         stop = asyncio.Event()
-        async with WeedClient(follower) as c:
+        # the client gets the FULL seed list: whichever master dies —
+        # including the one a single-seed client would be pointed at —
+        # the seed rotation must carry it through the failover
+        async with WeedClient(peers) as c:
             async def writer():
                 while not stop.is_set():
                     data = rng.randbytes(rng.randint(500, 8000))
