@@ -66,7 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="auto-vacuum cadence seconds; 0 disables")
     m.add_argument("-whiteList", default="",
                    help="comma-separated IPs/CIDRs allowed to use the "
-                        "API; empty = no limit (guard.go)")
+                        "API; empty = no limit (guard.go). Heartbeating "
+                        "volume servers are auto-admitted; with -peers, "
+                        "include the peer master IPs (proxied follower "
+                        "requests arrive from them)")
     m.add_argument("-volumePreallocate", action="store_true",
                    help="preallocate disk space for grown volumes")
 
@@ -101,9 +104,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "clients (host:port)")
     v.add_argument("-whiteList", default="",
                    help="comma-separated IPs/CIDRs with needle-write "
-                        "permission; empty = no limit. The /admin mesh "
-                        "is protected by mTLS (security.toml), not by "
-                        "this list")
+                        "permission; empty = no limit. With security."
+                        "toml mTLS the /admin mesh is cert-protected; "
+                        "without it /admin mutations fall under this "
+                        "list too (whitelist the master and peers)")
 
     f = sub.add_parser("filer", help="start a filer server")
     _add_common(f)

@@ -98,6 +98,7 @@ class MasterServer:
             self.seq = MemorySequencer()
         self.layouts: dict[LayoutKey, VolumeLayout] = {}
         self._watchers: list[asyncio.Queue] = []
+        self._peer_ips: set[str] = set()
         self._runner: web.AppRunner | None = None
         self._site: web.TCPSite | None = None
         self._tasks: list[asyncio.Task] = []
@@ -108,21 +109,31 @@ class MasterServer:
     # ------------------------------------------------------------------
     # the client-API paths the reference wraps with guard.WhiteList
     # (master_server.go:110-120). Deliberately NOT guarded: the UI, the
-    # fid redirect, the raft/heartbeat/watch mesh (mTLS-scoped instead)
-    # — and /dir/lookup, which volume servers call during replica
-    # fan-out (the reference's equivalent lookup rides gRPC, so its
-    # whitelist never sees it)
-    _GUARDED = ("/dir/assign", "/dir/status",
+    # fid redirect, the raft/heartbeat/watch mesh (mTLS-scoped instead).
+    # /dir/lookup IS guarded like the reference's master_server.go:111 —
+    # volume servers calling it during replica fan-out are auto-admitted
+    # by _is_peer (their IP is learned from heartbeats), so an operator
+    # whitelist only needs to cover clients. Peer masters proxying
+    # follower requests must still be whitelisted (matches reference).
+    _GUARDED = ("/dir/assign", "/dir/lookup", "/dir/status",
                 "/col/delete", "/vol/grow", "/vol/status", "/vol/vacuum",
                 "/vol/volumes", "/vol/ec_lookup", "/submit", "/stats/")
 
+    def _is_peer(self, ip: str | None) -> bool:
+        """Heartbeating volume servers are cluster members, not clients;
+        admit them on guarded paths regardless of -whiteList."""
+        return ip is not None and ip in self._peer_ips
+
     def _build_app(self) -> web.Application:
         from ..security.guard import middleware as guard_mw
+        from ..security.guard import path_guarded
         app = web.Application(
             client_max_size=64 * 1024 * 1024,
             middlewares=[guard_mw(
                 lambda: self.guard,
-                lambda req: req.path.startswith(self._GUARDED))])
+                lambda req: (path_guarded(req.path, self._GUARDED)
+                             and not (req.path == "/dir/lookup"
+                                      and self._is_peer(req.remote))))])
         app.router.add_route("*", "/dir/assign", self.h_assign)
         app.router.add_route("*", "/dir/lookup", self.h_lookup)
         app.router.add_get("/dir/status", self.h_dir_status)
@@ -280,6 +291,8 @@ class MasterServer:
                             content_type="text/plain")
 
     async def h_heartbeat(self, req: web.Request) -> web.Response:
+        if req.remote:
+            self._peer_ips.add(req.remote)
         if not self.is_leader:
             # volume servers must register with the leader; hand back the
             # hint so they chase it (master_grpc_server.go:165-175)

@@ -61,6 +61,34 @@ def middleware(guard_getter, is_guarded):
     return white_list_mw
 
 
+def path_guarded(path: str, prefixes) -> bool:
+    """True when `path` is one of the guarded endpoints.
+
+    Matches exact path or a sub-path (prefix + '/'); a bare
+    startswith() would also guard unrelated siblings like
+    /submitfoo. Entries already ending in '/' guard the subtree."""
+    for p in prefixes:
+        if p.endswith("/"):
+            if path.startswith(p):
+                return True
+        elif path == p or path.startswith(p + "/"):
+            return True
+    return False
+
+
 def parse_white_list(spec: str) -> list[str]:
-    """Comma-separated -whiteList flag value -> entries."""
-    return [e.strip() for e in (spec or "").split(",") if e.strip()]
+    """Comma-separated -whiteList flag value -> entries.
+
+    Validates eagerly so a typo'd entry fails the command cleanly
+    instead of dying later with an ipaddress traceback."""
+    entries = [e.strip() for e in (spec or "").split(",") if e.strip()]
+    for entry in entries:
+        try:
+            if "/" in entry:
+                ipaddress.ip_network(entry, strict=False)
+            else:
+                ipaddress.ip_address(entry)
+        except ValueError as e:
+            raise SystemExit(
+                f"invalid -whiteList entry {entry!r}: {e}") from None
+    return entries

@@ -96,17 +96,23 @@ class VolumeServer:
         store.fetch_remote_shard = None  # wired after start (needs loop)
 
     def _guarded_request(self, req: web.Request) -> bool:
-        # needle writes only: /admin/* is the inter-server mesh (master
+        # needle writes: /admin/* is the inter-server mesh (master
         # allocate/vacuum, peer copy/EC — mTLS-scoped like the
-        # reference's gRPC). Replica forwards come from peer volume
-        # servers an operator's client whitelist won't include, so they
-        # are exempt ONLY when the cluster enforces write JWTs (the
-        # forwarded per-fid token still authenticates them); without a
-        # jwt key the exemption would be a trivial guard bypass, so
-        # peers must then be whitelisted
+        # reference's gRPC), so it is exempt ONLY while mTLS is actually
+        # active; with -whiteList but no security.toml, an unlisted
+        # client 401'd on public DELETE could otherwise still tombstone
+        # needles via /admin/batch_delete or drop volumes via
+        # /admin/volume/delete. When mTLS is off, /admin mutations are
+        # guarded too and the master/peers must be whitelisted (warned
+        # at start()). Replica forwards come from peer volume servers an
+        # operator's client whitelist won't include, so they are exempt
+        # ONLY when the cluster enforces write JWTs (the forwarded
+        # per-fid token still authenticates them); without a jwt key the
+        # exemption would be a trivial guard bypass, so peers must then
+        # be whitelisted
         if req.method not in ("POST", "PUT", "DELETE"):
             return False
-        if req.path.startswith("/admin/"):
+        if req.path.startswith("/admin/") and tls.server_ctx() is not None:
             return False
         if req.query.get("type") == "replicate" and self.jwt_key:
             return False
@@ -164,6 +170,11 @@ class VolumeServer:
         return f"{self.ip}:{self.port}"
 
     async def start(self) -> None:
+        if not self.guard.empty and tls.server_ctx() is None:
+            glog.warning(
+                "-whiteList without security.toml mTLS: /admin "
+                "mutations are whitelist-guarded too — the master and "
+                "peer volume servers must be in the whitelist")
         self._http = tls.make_session(
             timeout=aiohttp.ClientTimeout(total=60))
         self._runner = web.AppRunner(self.app)

@@ -22,6 +22,22 @@ def test_guard_matching():
     import pytest
     with pytest.raises(ValueError):
         Guard(["10.0.0.256"])
+    # the CLI flag parser turns that into a clean exit, not a traceback
+    with pytest.raises(SystemExit, match="10.0.0.256"):
+        parse_white_list("127.0.0.1,10.0.0.256")
+
+
+def test_path_guarded_prefix_semantics():
+    from seaweedfs_tpu.security.guard import path_guarded
+    prefixes = ("/submit", "/vol/status", "/stats/")
+    assert path_guarded("/submit", prefixes)
+    assert path_guarded("/submit/extra", prefixes)
+    # unrelated siblings must NOT be guarded (plain startswith would)
+    assert not path_guarded("/submitfoo", prefixes)
+    assert not path_guarded("/vol/statusx", prefixes)
+    # entries ending in '/' guard the whole subtree
+    assert path_guarded("/stats/health", prefixes)
+    assert not path_guarded("/stats", prefixes)
 
 
 def test_white_list_enforced_over_http(tmp_path):
@@ -33,8 +49,10 @@ def test_white_list_enforced_over_http(tmp_path):
             async with c.http.get(
                     f"http://{c.master.url}/dir/assign") as resp:
                 assert resp.status == 401
-            # the mesh stays open: cluster status, /dir/lookup (replica
-            # fan-out calls it), raft/heartbeat
+            # the mesh stays open: cluster status, raft/heartbeat.
+            # /dir/lookup is guarded (master_server.go:111) but
+            # heartbeating volume-server IPs are auto-admitted — the
+            # loopback client shares the VS's IP here, so it passes
             async with c.http.get(
                     f"http://{c.master.url}/cluster/status") as resp:
                 assert resp.status == 200
@@ -42,8 +60,19 @@ def test_white_list_enforced_over_http(tmp_path):
                     f"http://{c.master.url}/dir/lookup",
                     params={"volumeId": "1"}) as resp:
                 assert resp.status != 401
-            # volume: client writes guarded; reads, the /admin mesh, and
-            # replica forwards (JWT-covered when enforced) stay open
+            # ...but a non-peer, non-whitelisted IP is rejected: clear
+            # the learned peer set to simulate a foreign client
+            saved_peers = set(c.master._peer_ips)
+            c.master._peer_ips.clear()
+            async with c.http.get(
+                    f"http://{c.master.url}/dir/lookup",
+                    params={"volumeId": "1"}) as resp:
+                assert resp.status == 401
+            c.master._peer_ips.update(saved_peers)
+            # volume: client writes guarded; without mTLS the /admin
+            # mutation surface is guarded too (else a 401'd client could
+            # still tombstone needles via /admin/batch_delete); reads
+            # and replica forwards (JWT-covered when enforced) stay open
             vs = c.servers[0].url
             async with c.http.post(f"http://{vs}/1,01deadbeef",
                                    data=b"x") as resp:
@@ -51,7 +80,11 @@ def test_white_list_enforced_over_http(tmp_path):
             async with c.http.post(
                     f"http://{vs}/admin/vacuum/check",
                     params={"volume": "1"}) as resp:
-                assert resp.status != 401
+                assert resp.status == 401
+            async with c.http.get(
+                    f"http://{vs}/admin/volume/status",
+                    params={"volume": "1"}) as resp:
+                assert resp.status != 401  # GETs aren't mutations
             # without write JWTs a ?type=replicate spoof must NOT bypass
             # the IP guard (peers have to be whitelisted instead)
             async with c.http.post(f"http://{vs}/9,01deadbeef",
