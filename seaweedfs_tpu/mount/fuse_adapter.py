@@ -20,13 +20,13 @@ from .wfs import WFS, MountOptions
 
 try:  # pragma: no cover - not installed in the build image
     from fuse import FUSE, FuseOSError, Operations
-    HAVE_FUSE = True
+    KERNEL_BINDING = "fusepy"
 except ImportError:
-    HAVE_FUSE = False
-    Operations = object
-
-    class FuseOSError(OSError):
-        pass
+    # built-in /dev/fuse wire-protocol binding (fusekernel.py) — same
+    # Operations surface, no third-party dependency
+    from .fusekernel import FUSE, FuseOSError, Operations
+    KERNEL_BINDING = "builtin"
+HAVE_FUSE = True
 
 
 def _errno_of(e: MountError) -> int:
@@ -186,10 +186,6 @@ def mount(filer, master_url: str, mountpoint: str,
           option: MountOptions | None = None,
           foreground: bool = True) -> None:  # pragma: no cover
     """command/mount_std.go runMount equivalent."""
-    if not HAVE_FUSE:
-        raise RuntimeError(
-            "no FUSE binding available (pip package 'fusepy'); the node "
-            "layer still works in-proc — see seaweedfs_tpu.mount.WFS")
     wfs = WFS(filer, master_url, option)
     FUSE(SeaweedFuseOps(wfs), mountpoint, foreground=foreground,
          nothreads=False, allow_other=False)
