@@ -337,6 +337,15 @@ def child_main() -> None:
     backend = jax.default_backend()
     _log(f"backend up: {backend} devices={jax.devices()}")
     _emit({"stage": "init", "backend": backend})
+    # tiny-transfer probe BEFORE any real device work: if the tunnel is
+    # up-but-wedged this hangs here (and the parent's init timeout kills
+    # a child that has transferred nothing), never mid-large-device_put —
+    # the round-4 wedge pattern
+    t0 = time.perf_counter()
+    probe = jax.device_put(np.arange(256, dtype=np.uint8))
+    assert int(jnp.sum(probe.astype(jnp.uint32))) == 255 * 128
+    _log(f"tiny-transfer probe ok ({time.perf_counter() - t0:.2f}s)")
+    _emit({"stage": "probe", "probe_s": round(time.perf_counter() - t0, 2)})
 
     from seaweedfs_tpu.ec import gf
     from seaweedfs_tpu.ops import gf256_mxu as gm
@@ -364,7 +373,7 @@ def child_main() -> None:
     }
 
     max_bytes = int(os.environ.get(
-        "SWTPU_BENCH_BYTES", str((64 << 20) if backend == "tpu"
+        "SWTPU_BENCH_BYTES", str((256 << 20) if backend == "tpu"
                                  else (1 << 20))))
     # chains sized so the timed region dwarfs the ~70ms dispatch rtt even
     # at ~100 GB/s (the adaptive growth in _chained_gbs backstops this)
