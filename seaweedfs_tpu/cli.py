@@ -823,12 +823,56 @@ async def _run_shell(args) -> None:
                     print(f"error: {e}")
 
 
-async def _run_benchmark(args) -> None:
-    """weed benchmark analog (command/benchmark.go): concurrent 1KB
-    writes + reads with latency percentiles."""
-    import random
+class _RawConn:
+    """One persistent raw HTTP/1.1 connection for the benchmark loop.
 
-    from .util.client import WeedClient
+    The reference's benchmark client is a lean Go net/http loop
+    (command/benchmark.go); a full aiohttp ClientSession here would
+    measure the client's own parser, not the servers, on a single core."""
+
+    __slots__ = ("r", "w", "hostport", "_hdr")
+
+    @classmethod
+    async def open(cls, hostport: str) -> "_RawConn":
+        host, _, port = hostport.rpartition(":")
+        c = cls.__new__(cls)
+        c.hostport = hostport
+        c.r, c.w = await asyncio.open_connection(
+            host or "127.0.0.1", int(port), ssl=tls.client_ctx())
+        c._hdr = f"\r\nHost: {hostport}\r\n".encode()
+        return c
+
+    async def request(self, method: str, path: str, body: bytes = b"",
+                      ctype: str = "") -> tuple[int, bytes]:
+        head = method.encode() + b" " + path.encode() + b" HTTP/1.1" \
+            + self._hdr
+        if body or method in ("POST", "PUT"):
+            head += b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+        if ctype:
+            head += b"Content-Type: " + ctype.encode() + b"\r\n"
+        self.w.write(head + b"\r\n" + body)
+        await self.w.drain()
+        hdr = await self.r.readuntil(b"\r\n\r\n")
+        status = int(hdr[9:12])
+        i = hdr.lower().find(b"content-length:")
+        cl = 0
+        if i >= 0:
+            cl = int(hdr[i + 15:hdr.index(b"\r\n", i)])
+        data = await self.r.readexactly(cl) if cl else b""
+        return status, data
+
+    def close(self) -> None:
+        try:
+            self.w.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+async def _run_benchmark(args) -> None:
+    """weed benchmark analog (command/benchmark.go): concurrent small-file
+    writes + reads with latency percentiles, over per-worker persistent
+    raw connections (see _RawConn)."""
+    import random
 
     rng = random.Random(0)
     payload = bytes(rng.getrandbits(8) for _ in range(args.size))
@@ -845,56 +889,112 @@ async def _run_benchmark(args) -> None:
         with open(args.idList) as f:
             fids = [ln.strip() for ln in f if ln.strip()]
 
-    async with WeedClient(args.master) as c:
-        sem = asyncio.Semaphore(args.concurrency)
+    master = args.master.split(",")[0]
+    assign_q = "/dir/assign"
+    qs = []
+    if args.collection:
+        qs.append(f"collection={args.collection}")
+    if args.replication:
+        qs.append(f"replication={args.replication}")
+    if qs:
+        assign_q += "?" + "&".join(qs)
+    vol_locs: dict[str, str] = {}       # vid -> host:port (lookup cache)
+    read_bytes = 0
+    wi = ri = 0                          # shared cursors (single loop)
 
-        async def write_one(i: int):
-            nonlocal deletes
-            async with sem:
-                t0 = time.perf_counter()
-                fid = await c.upload_data(payload,
-                                          collection=args.collection,
-                                          replication=args.replication)
-                # sample BEFORE any delete: the write percentiles must
-                # measure writes, not write+delete round trips
-                write_lat.append(time.perf_counter() - t0)
-                # random sampling like the reference (rand.Intn(100)):
-                # a modulo scheme front-loads deletes and skews the rate
-                # whenever n is not a multiple of 100
-                if args.deletePercent > 0 and \
-                        rng.randrange(100) < args.deletePercent:
-                    await c.delete_fids([fid])
-                    deletes += 1
+    async def lookup(mconn: _RawConn, vid: str) -> str:
+        url = vol_locs.get(vid)
+        if url is None:
+            st, body = await mconn.request(
+                "GET", f"/dir/lookup?volumeId={vid}")
+            if st != 200:
+                raise RuntimeError(f"lookup {vid}: {st}")
+            url = json.loads(body)["locations"][0]["url"]
+            vol_locs[vid] = url
+        return url
+
+    async def worker(phase: str, order: list[str]) -> None:
+        nonlocal deletes, read_bytes, wi, ri
+        mconn = await _RawConn.open(master)
+        vconns: dict[str, _RawConn] = {}
+
+        async def vconn(hostport: str) -> _RawConn:
+            c = vconns.get(hostport)
+            if c is None:
+                c = vconns[hostport] = await _RawConn.open(hostport)
+            return c
+
+        try:
+            while True:
+                if phase == "write":
+                    if wi >= args.n:
+                        return
+                    wi += 1
+                    t0 = time.perf_counter()
+                    st, body = await mconn.request("GET", assign_q)
+                    if st != 200:
+                        raise RuntimeError(f"assign: {body[:200]!r}")
+                    a = json.loads(body)
+                    fid = a["fid"]
+                    vc = await vconn(a["url"])
+                    path = "/" + fid
+                    auth = a.get("auth", "")
+                    if auth:
+                        # JWT rides as a query param the server accepts
+                        path += "?jwt=" + auth
+                    st, body = await vc.request("POST", path, payload)
+                    if st not in (200, 201):
+                        raise RuntimeError(f"upload {fid}: {st} "
+                                           f"{body[:200]!r}")
+                    # sample BEFORE any delete: the write percentiles
+                    # must measure writes, not write+delete round trips
+                    write_lat.append(time.perf_counter() - t0)
+                    # random sampling like the reference (rand.Intn(100)):
+                    # a modulo scheme front-loads deletes and skews the
+                    # rate whenever n is not a multiple of 100
+                    if args.deletePercent > 0 and \
+                            rng.randrange(100) < args.deletePercent:
+                        await vc.request("DELETE", "/" + fid)
+                        deletes += 1
+                    else:
+                        fids.append(fid)
                 else:
-                    fids.append(fid)
+                    if ri >= len(order):
+                        return
+                    fid = order[ri]
+                    ri += 1
+                    t0 = time.perf_counter()
+                    vc = await vconn(
+                        await lookup(mconn, fid.split(",")[0]))
+                    st, data = await vc.request("GET", "/" + fid)
+                    if st != 200:
+                        raise RuntimeError(f"read {fid}: {st}")
+                    read_lat.append(time.perf_counter() - t0)
+                    read_bytes += len(data)
+        finally:
+            mconn.close()
+            for c in vconns.values():
+                c.close()
 
-        wdt = 0.0
-        if do_write:
-            t0 = time.perf_counter()
-            await asyncio.gather(*(write_one(i) for i in range(args.n)))
-            wdt = time.perf_counter() - t0
-            if args.idList:
-                with open(args.idList, "w") as f:
-                    f.write("\n".join(fids) + "\n")
+    wdt = 0.0
+    if do_write:
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker("write", [])
+                               for _ in range(args.concurrency)))
+        wdt = time.perf_counter() - t0
+        if args.idList:
+            with open(args.idList, "w") as f:
+                f.write("\n".join(fids) + "\n")
 
-        read_bytes = 0
-
-        async def read_one(fid: str):
-            nonlocal read_bytes
-            async with sem:
-                t0 = time.perf_counter()
-                data = await c.read(fid)
-                read_lat.append(time.perf_counter() - t0)
-                read_bytes += len(data)
-
-        rdt = 0.0
-        if do_read and fids:
-            order = list(fids)
-            if args.readSequentially != "true":
-                rng.shuffle(order)
-            t0 = time.perf_counter()
-            await asyncio.gather(*(read_one(f) for f in order))
-            rdt = time.perf_counter() - t0
+    rdt = 0.0
+    if do_read and fids:
+        order = list(fids)
+        if args.readSequentially != "true":
+            rng.shuffle(order)
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker("read", order)
+                               for _ in range(args.concurrency)))
+        rdt = time.perf_counter() - t0
 
     def pct(xs, p):
         xs = sorted(xs)

@@ -167,11 +167,14 @@ class MasterServer:
             timeout=aiohttp.ClientTimeout(total=30))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        self._site = web.TCPSite(self._runner, self.ip, self.port,
-                            ssl_context=tls.server_ctx())
-        await self._site.start()
+        # public listener: /dir/assign answered straight off the socket,
+        # everything else upgrades in place onto the aiohttp app
+        from ..server.fasthttp import FastAssignProtocol
+        self._server = await asyncio.get_running_loop().create_server(
+            lambda: FastAssignProtocol(self), self.ip, self.port,
+            ssl=tls.server_ctx(), reuse_address=True)
         if self.port == 0:
-            self.port = self._site._server.sockets[0].getsockname()[1]
+            self.port = self._server.sockets[0].getsockname()[1]
         self.election = Election(
             self.url, self._peers,
             election_timeout=self._election_timeout,
@@ -196,8 +199,25 @@ class MasterServer:
             task.cancel()
         if self._http:
             await self._http.close()
+        if getattr(self, "_server", None) is not None:
+            self._server.close()
+            # NOT wait_closed() (3.12 waits on live keep-alives)
+            for tr in list(getattr(self, "_fast_conns", ())):
+                tr.close()
         if self._runner:
             await self._runner.cleanup()
+
+    _assign_ctr = None
+
+    def count_assign(self) -> None:
+        """Cached assign counter for the fast path."""
+        from ..stats import metrics
+        if not metrics.HAVE_PROMETHEUS:
+            return
+        if self._assign_ctr is None:
+            self._assign_ctr = \
+                metrics.MASTER_ASSIGN_REQUESTS.labels("ok")
+        self._assign_ctr.inc()
 
     # ---- layouts ----
 

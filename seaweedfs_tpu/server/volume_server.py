@@ -179,11 +179,15 @@ class VolumeServer:
             timeout=aiohttp.ClientTimeout(total=60))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.ip, self.port,
-                            ssl_context=tls.server_ctx())
-        await site.start()
+        # the public listener speaks the hand-rolled needle fast path
+        # (fasthttp.py); cold requests upgrade in place onto the aiohttp
+        # app served by self._runner
+        from .fasthttp import FastNeedleProtocol
+        self._server = await asyncio.get_running_loop().create_server(
+            lambda: FastNeedleProtocol(self), self.ip, self.port,
+            ssl=tls.server_ctx(), reuse_address=True)
         if self.port == 0:
-            self.port = site._server.sockets[0].getsockname()[1]
+            self.port = self._server.sockets[0].getsockname()[1]
         self.store.ip = self.ip
         self.store.port = self.port
         if self.public_url:
@@ -203,9 +207,30 @@ class VolumeServer:
             task.cancel()
         if self._http:
             await self._http.close()
+        if getattr(self, "_server", None) is not None:
+            self._server.close()
+            # NOT wait_closed(): since 3.12 it waits for every open
+            # keep-alive connection; drop fast-path transports directly
+            for tr in list(getattr(self, "_fast_conns", ())):
+                tr.close()
         if self._runner:
             await self._runner.cleanup()
         self.store.close()
+
+    _counters: dict = None  # type: ignore[assignment]
+
+    def count(self, op: str, status: str) -> None:
+        """Cheap request-counter hook for the fast path (labels cached)."""
+        from ..stats import metrics
+        if not metrics.HAVE_PROMETHEUS:
+            return
+        if self._counters is None:
+            self._counters = {}
+        c = self._counters.get((op, status))
+        if c is None:
+            c = self._counters[(op, status)] = \
+                metrics.VOLUME_REQUEST_COUNTER.labels(op, status)
+        c.inc()
 
     def _lookup_ec_locations(self, vid: int) -> dict | None:
         """One master /vol/ec_lookup call (executor threads only)."""
