@@ -151,6 +151,7 @@ class MasterServer:
         app.router.add_route("*", "/submit", self.h_submit)
         app.router.add_post("/raft/vote", self.h_raft_vote)
         app.router.add_post("/raft/heartbeat", self.h_raft_heartbeat)
+        app.router.add_post("/raft/snapshot", self.h_raft_snapshot)
         app.router.add_get("/ui", self.h_ui)
         app.router.add_get("/", self.h_ui)
         # catch-all LAST: GET /<fid> redirects to a holder of the volume
@@ -258,15 +259,30 @@ class MasterServer:
 
     async def h_raft_vote(self, req: web.Request) -> web.Response:
         body = await req.json()
+        lli = body.get("last_log_index")
         return web.json_response(self.election.on_vote_request(
             int(body["term"]), body["candidate"],
-            int(body.get("max_volume_id", 0))))
+            int(body.get("max_volume_id", 0)),
+            last_log_index=None if lli is None else int(lli),
+            last_log_term=int(body.get("last_log_term", 0) or 0)))
 
     async def h_raft_heartbeat(self, req: web.Request) -> web.Response:
         body = await req.json()
+        if "prev_index" in body:
+            return web.json_response(self.election.on_append(
+                int(body["term"]), body["leader"],
+                int(body["prev_index"]), int(body["prev_term"]),
+                list(body.get("entries", [])), int(body.get("commit", 0))))
+        # legacy pulse (value inline, no log coordinates)
         return web.json_response(self.election.on_leader_pulse(
             int(body["term"]), body["leader"],
             int(body.get("max_volume_id", 0))))
+
+    async def h_raft_snapshot(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        return web.json_response(self.election.on_install_snapshot(
+            int(body["term"]), body["leader"], int(body["last_index"]),
+            int(body["last_term"]), int(body.get("value", 0))))
 
     def _leader_or_503(self) -> tuple[str | None, web.Response | None]:
         """Resolve the current leader, or the 503 every non-leader
