@@ -10,6 +10,7 @@ over real sockets, and byte-verifies every surviving file at the end.
     python tools/soak.py vacuum-race   # writers+deletes racing vacuum rounds
     python tools/soak.py rebuild       # encode, SIGKILL a shard holder, rebuild
     python tools/soak.py failover      # SIGKILL the leader master under load
+    python tools/soak.py partition     # cut the leader's raft links (alive)
     python tools/soak.py all
 
 Exit code 0 only when every read verifies.
@@ -303,11 +304,239 @@ async def scenario_failover(tmp: str) -> int:
         procs.kill_all()
 
 
+class PairProxy:
+    """Userspace TCP link for ONE direction of a master pair; the soak
+    cuts it to simulate a network partition (both processes stay alive —
+    the failure class SIGKILL soaks can't produce)."""
+
+    def __init__(self, name: str, listen_port: int, target_port: int,
+                 cut: set):
+        self.name = name
+        self.listen_port = listen_port
+        self.target_port = target_port
+        self.cut = cut              # shared: {name} membership = severed
+        self.conns: set = set()
+        self.server = None
+
+    async def start(self) -> None:
+        self.server = await asyncio.start_server(
+            self._handle, "127.0.0.1", self.listen_port)
+
+    def sever(self) -> None:
+        for w in list(self.conns):
+            w.close()
+
+    async def _handle(self, r, w) -> None:
+        if self.name in self.cut:
+            w.close()
+            return
+        try:
+            tr, tw = await asyncio.open_connection(
+                "127.0.0.1", self.target_port)
+        except OSError:
+            w.close()
+            return
+        self.conns.update((w, tw))
+
+        async def pipe(a, b):
+            try:
+                while True:
+                    d = await a.read(65536)
+                    if not d or self.name in self.cut:
+                        break
+                    b.write(d)
+                    await b.drain()
+            except Exception:  # noqa: BLE001
+                pass
+            finally:
+                try:
+                    b.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        await asyncio.gather(pipe(r, tw), pipe(tr, w),
+                             return_exceptions=True)
+        self.conns.difference_update((w, tw))
+
+
+async def scenario_partition(tmp: str) -> int:
+    """Cut the LEADER's raft links (both directions, processes alive):
+    the minority master must stop assigning within its lease, the
+    majority must elect a successor, writes must keep flowing, and the
+    heal must leave ONE leader and ZERO duplicate fids. Reference
+    behavior contract: raft_server.go:28-97 (chrislusf/raft leader
+    lease + election under partition)."""
+    import json
+
+    from seaweedfs_tpu.util.client import WeedClient
+    procs = Procs(tmp)
+    port0 = BASE_PORT + 40
+    real = [f"127.0.0.1:{port0 + i}" for i in range(3)]
+    # directed-pair proxies: master i dials master j via Q[i][j]; raft
+    # traffic (and only raft traffic) rides these links
+    qport = {(i, j): port0 + 50 + i * 3 + j
+             for i in range(3) for j in range(3) if i != j}
+    cut: set = set()
+    proxies = {}
+    for (i, j), qp in qport.items():
+        proxies[(i, j)] = PairProxy(f"{i}->{j}", qp, port0 + j, cut)
+    for p in proxies.values():
+        await p.start()
+    try:
+        for i in range(3):
+            peer_list = ",".join(
+                [real[i]] + [f"127.0.0.1:{qport[(i, j)]}"
+                             for j in range(3) if j != i])
+            procs.spawn("master", "-port", str(port0 + i),
+                        "-mdir", os.path.join(procs.tmp, f"m{i}"),
+                        "-peers", peer_list, "-pulseSeconds", "1",
+                        "-sequencer",
+                        f"file:{os.path.join(procs.tmp, f'seq{i}')}")
+        # asyncio.sleep / to_thread, NOT time.sleep: the pair proxies run
+        # on THIS loop — blocking it severs every raft link at once
+        await asyncio.sleep(4)
+        for i in range(2):
+            procs.spawn("volume", "-port", str(port0 + 10 + i),
+                        "-dir", os.path.join(procs.tmp, f"v{i}"),
+                        "-max", "16", "-master", ",".join(real),
+                        "-pulseSeconds", "1")
+        await asyncio.to_thread(wait_assign, real[0], "replication=001")
+
+        def status(url):
+            with urllib.request.urlopen(
+                    f"http://{url}/cluster/status", timeout=3) as r:
+                return json.load(r)
+
+        leader = (await asyncio.to_thread(status, real[0]))["leader"]
+        li = real.index(leader)
+        others = [i for i in range(3) if i != li]
+        print(f"  leader is master{li} ({leader})")
+
+        rng = random.Random(17)
+        payloads: dict = {}
+        errors: list = []
+        stop = asyncio.Event()
+        async with WeedClient(",".join(real)) as c:
+            async def writer():
+                while not stop.is_set():
+                    data = rng.randbytes(rng.randint(500, 8000))
+                    try:
+                        fid = await c.upload_data(data,
+                                                  replication="001")
+                        payloads[fid] = data
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(str(e)[:60])
+                        await asyncio.sleep(0.2)
+
+            writers = [asyncio.create_task(writer()) for _ in range(6)]
+            await asyncio.sleep(4)
+            pre = len(payloads)
+
+            # ---- CUT: isolate the leader from both peers ----
+            for j in others:
+                cut.add(f"{li}->{j}")
+                cut.add(f"{j}->{li}")
+            for p in proxies.values():
+                if p.name in cut:
+                    p.sever()
+            t_cut = time.time()
+            print(f"  partition: master{li} isolated "
+                  f"({len(payloads)} files so far)")
+
+            # majority elects a successor; old leader must step down
+            new_leader = None
+            while time.time() - t_cut < 30:
+                await asyncio.sleep(0.5)
+                try:
+                    st = await asyncio.to_thread(status, real[others[0]])
+                    if st["leader"] and st["leader"] != leader:
+                        new_leader = st["leader"]
+                        break
+                except OSError:
+                    pass
+            bad = 0
+            if not new_leader:
+                print("  FAIL: majority elected no successor in 30s")
+                bad += 1
+            else:
+                print(f"  new leader {new_leader} after "
+                      f"{time.time() - t_cut:.1f}s")
+            # the isolated minority master must NOT be assigning: its
+            # lease expired and it has no quorum
+            await asyncio.sleep(1.5)
+            try:
+                st = await asyncio.to_thread(status, real[li])
+                if st.get("isLeader"):
+                    print("  FAIL: isolated master still claims "
+                          "leadership past its lease")
+                    bad += 1
+                with urllib.request.urlopen(
+                        f"http://{real[li]}/dir/assign?replication=001",
+                        timeout=3) as r:
+                    body = json.load(r)
+                    if "fid" in body:
+                        print(f"  FAIL: isolated master still assigns: "
+                              f"{body}")
+                        bad += 1
+            except (OSError, ValueError):
+                pass  # refusing/erroring is the correct behavior
+
+            # writes must keep flowing through the majority
+            t0 = time.time()
+            while len(payloads) <= pre and time.time() - t0 < 30:
+                await asyncio.sleep(0.5)
+            if len(payloads) <= pre:
+                print("  FAIL: no write succeeded during the partition")
+                bad += 1
+            await asyncio.sleep(4)
+
+            # ---- HEAL ----
+            cut.clear()
+            print(f"  healed ({len(payloads)} files, "
+                  f"{len(errors)} transient errors)")
+            t_heal = time.time()
+            converged = False
+            while time.time() - t_heal < 30:
+                await asyncio.sleep(0.5)
+                try:
+                    sts = [await asyncio.to_thread(status, u)
+                           for u in real]
+                except OSError:
+                    continue
+                leaders = {st["leader"] for st in sts}
+                claiming = [st for st in sts if st.get("isLeader")]
+                if len(leaders) == 1 and "" not in leaders \
+                        and len(claiming) == 1:
+                    converged = True
+                    break
+            if not converged:
+                print("  FAIL: masters did not converge on one leader "
+                      "after heal")
+                bad += 1
+            await asyncio.sleep(3)
+            stop.set()
+            await asyncio.gather(*writers, return_exceptions=True)
+
+            # ZERO duplicate fids across the whole run: upload_data
+            # would have overwritten payloads[fid] silently, so count
+            # via a second write-log? payloads keys ARE the issued fids;
+            # a duplicate issue to two writers would byte-mismatch one
+            # of them in verify below. Also verify every byte.
+            bad += await verify(c, payloads, "after partition heal")
+            return bad
+    finally:
+        for p in proxies.values():
+            if p.server:
+                p.server.close()
+        procs.kill_all()
+
+
 SCENARIOS = {
     "ec": scenario_ec,
     "vacuum-race": scenario_vacuum_race,
     "rebuild": scenario_rebuild,
     "failover": scenario_failover,
+    "partition": scenario_partition,
 }
 
 
