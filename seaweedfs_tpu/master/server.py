@@ -589,7 +589,9 @@ class MasterServer:
                 "volumes": [m.to_dict() for m in node.volumes.values()],
                 "ecShards": [m.to_dict() for m in node.ec_shards.values()],
             })
-        return web.json_response({"nodes": out})
+        return web.json_response({
+            "nodes": out,
+            "volumeSizeLimitMB": self.volume_size_limit >> 20})
 
     async def h_ec_lookup(self, req: web.Request) -> web.Response:
         """vid -> {shard_id: [urls]} (LookupEcVolume, topology_ec.go:97-133)."""

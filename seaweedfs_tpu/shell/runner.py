@@ -21,7 +21,7 @@ HELP = """commands:
   ec.balance   [-collection c] [-force]
   volume.vacuum          [-garbageThreshold 0.3] [-collection c]
   volume.fix.replication [-force]
-  volume.balance         [-force]
+  volume.balance         [-force] [-collection ALL_COLLECTIONS|EACH_COLLECTION|name] [-dataCenter dc]
   volume.move   -volumeId n -source host:port -target host:port
   volume.copy   -volumeId n -source host:port -target host:port
   volume.mount   -volumeId n -node host:port [-collection c]
@@ -147,7 +147,9 @@ async def dispatch(env: CommandEnv, line: str) -> object:
             env, apply_changes=flags.get("force") == "true")
     elif cmd == "volume.balance":
         res = await vc.volume_balance(
-            env, apply_changes=flags.get("force") == "true")
+            env, apply_changes=flags.get("force") == "true",
+            collection=flags.get("collection", "EACH_COLLECTION"),
+            data_center=flags.get("dataCenter", ""))
     elif cmd == "volume.move":
         await vc.volume_move(env, int(flags["volumeId"]),
                              flags.get("collection", ""),

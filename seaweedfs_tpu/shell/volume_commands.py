@@ -92,32 +92,100 @@ async def volume_fix_replication(env: CommandEnv,
     return actions
 
 
-async def volume_balance(env: CommandEnv,
-                         apply_changes: bool = True) -> list[dict]:
-    """Plan moves from the fullest to the emptiest nodes until counts are
-    within one of each other, then apply (command_volume_balance.go).
-    Planned against one topology snapshot (the master registry lags moves
-    until the next heartbeat)."""
-    snapshot = {n["url"]: {"volumes": {m["id"]: m for m in n["volumes"]},
-                           "free": n["freeSlots"]}
-                for n in await env.list_nodes()}
+def plan_balance(nodes: list[dict], volume_size_limit: int,
+                 collection: str = "EACH_COLLECTION",
+                 data_center: str = "") -> list[dict]:
+    """Pure balance planner, the reference's documented algorithm
+    (command_volume_balance.go:29-100):
+
+      * volume servers are grouped by TYPE (their max-volume capacity;
+        collectVolumeServersByType), optionally filtered by -dataCenter;
+        a type with fewer than two nodes is skipped;
+      * -collection selects one collection, ALL_COLLECTIONS, or
+        EACH_COLLECTION (default: one balancing pass per collection);
+      * per scope, WRITABLE volumes (not read-only, under the size
+        limit; move candidates ordered by size ascending) are balanced
+        first, then READ-ONLY volumes (ordered by id);
+      * balanceSelectedVolume: ideal = ceil(selected / nodes); while the
+        fullest node is above ideal and the emptiest fits one more,
+        move the first candidate the emptiest node does not already
+        hold (never co-locating replicas of one volume).
+
+    Operates on a /vol/volumes snapshot; returns the move plan."""
+    import math
+
+    by_type: dict[int, list[dict]] = {}
+    for n in nodes:
+        if data_center and n.get("dataCenter", "") != data_center:
+            continue
+        by_type.setdefault(n.get("maxVolumes", 0), []).append(
+            {"url": n["url"],
+             "volumes": {m["id"]: m for m in n["volumes"]},
+             "selected": {}})
     moves: list[dict] = []
-    while len(snapshot) >= 2:
-        ordered = sorted(snapshot.items(), key=lambda kv: len(kv[1]["volumes"]))
-        (low_url, low), (high_url, high) = ordered[0], ordered[-1]
-        if len(high["volumes"]) - len(low["volumes"]) <= 1 or low["free"] <= 0:
-            break
-        movable = [m for vid, m in high["volumes"].items()
-                   if vid not in low["volumes"]]
-        if not movable:
-            break
-        m = movable[0]
-        moves.append({"volume": m["id"], "collection": m["collection"],
-                      "from": high_url, "to": low_url})
-        low["volumes"][m["id"]] = m
-        low["free"] -= 1
-        del high["volumes"][m["id"]]
-        high["free"] += 1
+
+    def balance_selected(group: list[dict], order_key) -> None:
+        total = sum(len(n["selected"]) for n in group)
+        ideal = math.ceil(total / len(group))
+        while True:
+            group.sort(key=lambda n: len(n["selected"]))
+            empty, full = group[0], group[-1]
+            if not (len(full["selected"]) > ideal
+                    and len(empty["selected"]) + 1 <= ideal):
+                return
+            candidates = sorted(full["selected"].values(), key=order_key)
+            for m in candidates:
+                if m["id"] not in empty["volumes"]:
+                    moves.append({"volume": m["id"],
+                                  "collection": m["collection"],
+                                  "from": full["url"],
+                                  "to": empty["url"]})
+                    del full["selected"][m["id"]]
+                    del full["volumes"][m["id"]]
+                    empty["selected"][m["id"]] = m
+                    empty["volumes"][m["id"]] = m
+                    break
+            else:
+                return  # every candidate already has a copy on `empty`
+
+    for group in by_type.values():
+        if len(group) < 2:
+            continue
+        if collection == "EACH_COLLECTION":
+            scopes = sorted({m["collection"] for n in group
+                             for m in n["volumes"].values()})
+        elif collection == "ALL_COLLECTIONS":
+            scopes = [None]
+        else:
+            scopes = [collection]
+        for scope in scopes:
+            for sel, order_key in (
+                    (lambda m: not m.get("read_only")
+                     and m.get("size", 0) < volume_size_limit,
+                     lambda m: m.get("size", 0)),
+                    (lambda m: m.get("read_only")
+                     or m.get("size", 0) >= volume_size_limit,
+                     lambda m: m["id"])):
+                for n in group:
+                    n["selected"] = {
+                        vid: m for vid, m in n["volumes"].items()
+                        if (scope is None or m["collection"] == scope)
+                        and sel(m)}
+                balance_selected(group, order_key)
+    return moves
+
+
+async def volume_balance(env: CommandEnv,
+                         apply_changes: bool = True,
+                         collection: str = "EACH_COLLECTION",
+                         data_center: str = "") -> list[dict]:
+    """Plan per-type/per-collection balance moves (plan_balance), then
+    apply them with volume.move. Planned against one topology snapshot
+    (the master registry lags moves until the next heartbeat)."""
+    body = await env.master_get("/vol/volumes")
+    limit = int(body.get("volumeSizeLimitMB", 30_000)) * 1024 * 1024
+    moves = plan_balance(body["nodes"], limit,
+                         collection=collection, data_center=data_center)
     if apply_changes:
         for mv in moves:
             await volume_move(env, mv["volume"], mv["collection"],

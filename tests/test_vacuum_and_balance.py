@@ -119,3 +119,80 @@ def test_volume_balance(tmp_path):
             counts = sorted(len(vs.store.volumes) for vs in c.servers)
             assert counts == [2, 2]
     run(body())
+
+
+def _fake_node(url, dc, max_volumes, volumes):
+    return {"url": url, "dataCenter": dc, "rack": "r1",
+            "maxVolumes": max_volumes, "freeSlots": max_volumes - len(volumes),
+            "volumes": volumes, "ecShards": []}
+
+
+def _vol(vid, collection="", size=100, read_only=False):
+    return {"id": vid, "collection": collection, "size": size,
+            "read_only": read_only}
+
+
+def test_plan_balance_reference_algorithm():
+    """Planner parity with command_volume_balance.go:29-100 on a skewed
+    fake topology: per-type grouping, per-collection passes, writable
+    (size-ordered) vs read-only (id-ordered) phases, ceil-ideal target,
+    no replica co-location."""
+    limit = 1000
+    # type 8: one hot node with everything, one empty
+    nodes = [
+        _fake_node("a:1", "dc1", 8, [
+            _vol(1, "photos", size=10), _vol(2, "photos", size=900),
+            _vol(3, "photos", size=50),
+            _vol(4, "docs"), _vol(5, "docs"),
+            _vol(6, "photos", read_only=True),
+            _vol(7, "photos", size=2000),      # oversized => read-only pass
+        ]),
+        _fake_node("b:2", "dc1", 8, []),
+        # a different TYPE (capacity 4): must be balanced separately and
+        # never mixed with the capacity-8 group
+        _fake_node("c:3", "dc1", 4, [_vol(20, "photos"), _vol(21, "photos")]),
+        # same type but other DC (for the dataCenter filter case)
+        _fake_node("d:4", "dc2", 8, [_vol(30, "photos")]),
+    ]
+    moves = vc.plan_balance([dict(n, volumes=list(n["volumes"]))
+                             for n in nodes], limit)
+    # capacity-4 group has one node in dc1 +... c:3 alone in its type
+    # within the full node set? d:4 is capacity 8 => group {a,b,d}
+    by_from = {}
+    for m in moves:
+        by_from.setdefault(m["from"], []).append(m)
+    # photos writable: a holds vids 1,2,3 (sizes 10,900,50), d holds
+    # vid 30 => 4 over 3 nodes -> ideal ceil(4/3)=2; one move brings a
+    # down to 2 == ideal, and the SMALLEST (vid 1, size 10) moves first
+    photo_moves = [m for m in moves if m["collection"] == "photos"
+                   and m["volume"] in (1, 2, 3)]
+    assert [m["volume"] for m in photo_moves] == [1]
+    assert photo_moves[0]["to"] == "b:2"
+    # docs: 2 volumes over 3 nodes -> ideal 1 -> one move
+    assert len([m for m in moves if m["collection"] == "docs"]) == 1
+    # read-only pass: vid 6 (flag) + vid 7 (oversized), ideal 1 -> one
+    # moves, id-ordered so vid 6 first
+    ro = [m for m in moves if m["volume"] in (6, 7)]
+    assert len(ro) == 1 and ro[0]["volume"] == 6
+    # the capacity-4 type has a single node => untouched
+    assert not [m for m in moves if m["from"] == "c:3"]
+
+    # -dataCenter filter: only dc2 nodes considered; single node => no-op
+    moves_dc2 = vc.plan_balance([dict(n) for n in nodes], limit,
+                                data_center="dc2")
+    assert moves_dc2 == []
+
+
+def test_plan_balance_never_colocates_replicas():
+    limit = 1000
+    # both nodes hold a copy of volume 1 (replica 001); a:1 also holds
+    # two movable singles
+    nodes = [
+        _fake_node("a:1", "dc1", 8,
+                   [_vol(1), _vol(2), _vol(3)]),
+        _fake_node("b:2", "dc1", 8, [_vol(1)]),
+    ]
+    moves = vc.plan_balance(nodes, limit)
+    assert all(m["volume"] != 1 for m in moves)
+    # counts end within one of each other
+    assert len(moves) == 1 and moves[0]["volume"] in (2, 3)
