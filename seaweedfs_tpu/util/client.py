@@ -204,24 +204,50 @@ class WeedClient:
 
     async def read(self, fid: str, offset: int = 0,
                    size: int = -1) -> bytes:
-        url = await self.lookup_file_id(fid)
+        """Read with location failover: every holder from the lookup is
+        tried (the reference's readUrl does the same across replicas /
+        EC shard holders); a dead first holder must not fail the read.
+        On a full miss the cached locations are invalidated and one
+        fresh lookup retries — a killed server stays in the 10-min vid
+        cache otherwise."""
+        vid = fid.split(",")[0]
         headers = {}
         if offset or size >= 0:
             end = "" if size < 0 else str(offset + size - 1)
             headers["Range"] = f"bytes={offset}-{end}"
-        async with self.http.get(url, headers=headers) as resp:
-            if resp.status in (404, 410):
-                raise OperationError(f"read {fid}: not found")
-            data = await resp.read()
-            if resp.status >= 400:
-                # an error body must never masquerade as file content
-                raise OperationError(
-                    f"read {fid}: http {resp.status} "
-                    f"{data[:200].decode(errors='replace')}")
-        if resp.status == 200 and (offset or size >= 0):
-            # server ignored Range; slice locally
-            data = data[offset:offset + size if size >= 0 else None]
-        return data
+        last: str = "no locations"
+        for attempt in range(2):
+            try:
+                locs = await self.lookup(vid)
+            except OperationError as e:
+                last = str(e)
+                break
+            for loc in locs:
+                url = tls.url(loc["publicUrl"], f"/{fid}")
+                try:
+                    async with self.http.get(url, headers=headers) as resp:
+                        if resp.status in (404, 410):
+                            # authoritative: the holder says it is gone
+                            raise OperationError(f"read {fid}: not found")
+                        data = await resp.read()
+                        if resp.status >= 400:
+                            # an error body must never masquerade as
+                            # file content; 5xx => try the next holder
+                            last = (f"http {resp.status} "
+                                    f"{data[:200].decode(errors='replace')}")
+                            continue
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError) as e:
+                    last = f"{type(e).__name__} {e}"
+                    continue
+                if resp.status == 200 and (offset or size >= 0):
+                    # server ignored Range; slice locally
+                    data = data[offset:offset + size if size >= 0
+                                else None]
+                return data
+            if attempt == 0:
+                self.invalidate(vid)  # stale holders: refresh + retry
+        raise OperationError(f"read {fid}: {last}")
 
     async def delete_fids(self, fids: list[str]) -> int:
         """Batch delete grouped per volume server
