@@ -515,6 +515,51 @@ def child_main() -> None:
                  f"{speeds.get('vpu', 0):.2f})")
             emit_cumulative(n)
 
+    def run_crc() -> None:
+        """Device CRC32C (ops/crc32c_jax.py GF(2)-matmul formulation,
+        SURVEY §2b item 2) vs the host SSE4.2 path — decides whether
+        folding checksums into the device pipeline pays."""
+        try:
+            from seaweedfs_tpu.ops.crc32c_jax import crc32c_batch
+            from seaweedfs_tpu.util import crc32c as hostcrc
+
+            bsz, n = (64, 1 << 20) if backend == "tpu" else (4, 64 << 10)
+            n = min(n, max(max_bytes, 64 << 10))
+            mk = jax.jit(lambda key: jax.random.randint(
+                key, (bsz, n), 0, 256, jnp.uint8))
+            dev = mk(jax.random.PRNGKey(5))
+            jax.block_until_ready(dev)
+            # oracle first: a fast-but-wrong checksum is never reported
+            got = np.asarray(crc32c_batch(dev[:2, :]))
+            host = np.frombuffer(
+                np.asarray(dev[:2, :]).tobytes(), np.uint8).reshape(2, n)
+            want = [hostcrc.crc32c(r.tobytes()) for r in host]
+            if list(got) != want:
+                raise RuntimeError("device crc mismatch vs host oracle")
+            jax.block_until_ready(crc32c_batch(dev))  # compile
+            t0 = time.perf_counter()
+            jax.block_until_ready(crc32c_batch(dev))  # warm timing probe
+            once = time.perf_counter() - t0
+            iters = min(50, max(2, int(max(1.0, 10 * rtt)
+                                       / max(once, 1e-4)) + 1))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                r = crc32c_batch(dev)
+            jax.block_until_ready(r)
+            dt = (time.perf_counter() - t0) / iters
+            gbs = bsz * n / dt / 1e9
+            if gbs > HBM_BOUND_GBPS:
+                raise ImplausibleResult(f"crc {gbs:.0f} GB/s > HBM bound")
+            t0 = time.perf_counter()
+            for r_ in host:
+                hostcrc.crc32c(r_.tobytes())
+            host_gbs = 2 * n / (time.perf_counter() - t0) / 1e9
+            _log(f"crc32c device {gbs:.2f} GB/s vs host {host_gbs:.2f}")
+            _emit({"stage": "crc", "crc_device_GBps": round(gbs, 3),
+                   "crc_host_GBps": round(host_gbs, 3)})
+        except Exception as e:  # noqa: BLE001
+            _emit({"stage": "crc", "crc_error": str(e)[:200]})
+
     # schedule: first stage decides the kernel race, then the flagship
     # batched config runs EARLY (round-3 lost it to budget exhaustion at
     # the tail), then the winner's size curve, then block-size autotune
@@ -528,6 +573,8 @@ def child_main() -> None:
             _log(f"budget exhausted before stage n={n >> 20}MB — stopping")
             break
         run_stage(n, chain_len)
+    if left() > 45:
+        run_crc()
     if left() > 60 and "vpu" in good and backend == "tpu":
         tune_block_bm()
     _emit({"stage": "done", "backend": backend})
@@ -653,7 +700,8 @@ def main() -> None:
         result["value"] = round(float(merged["value"]), 2)
         for key in ("encode_GBps", "rebuild4_GBps", "paths",
                     "paths_verified", "batched_encode_GBps",
-                    "batched_encode_error"):
+                    "batched_encode_error", "crc_device_GBps",
+                    "crc_host_GBps", "crc_error"):
             if key in merged:
                 result[key] = merged[key]
         if cpu_gbs > 0:

@@ -1,0 +1,126 @@
+"""CRC32-Castagnoli on device as GF(2) matrix algebra.
+
+Reference: the reference computes CRC32C on every needle write/read via
+klauspost/crc32's SSE4.2/CLMUL path (weed/storage/needle/crc.go:11-25,
+go.mod:40). A byte-serial CRC loop is the worst possible TPU program —
+but CRC is LINEAR over GF(2): with zero-init, crc_state(M) = B·bits(M)
+for a fixed 0/1 matrix B, and the 0xFFFFFFFF init folds in as one
+affine constant. That turns a whole batch of checksums into integer
+matmuls (int8 × int8 → int32 on the MXU) followed by `& 1`:
+
+  stage 1   bits(B, K, L*8) @ BlockMat(L*8, 32)  -> per-block states
+  stage 2   Y(B, K*32)      @ PowMat(K*32, 32)   -> whole-message state
+
+BlockMat folds a byte's table contribution through the remaining
+zero-byte advances inside its block; PowMat folds each block's state
+through the remaining blocks (powers of the L-byte advance operator).
+Both are precomputed on host per (n, L) and cached — they depend only
+on the message length, not the data.
+
+This is the SURVEY §2b item-2 surface: checksums ride along with
+device-resident stripe data (e.g. verifying reconstructed needles or
+scrubbing shards) instead of a host pass per buffer.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+_POLY = 0x82F63B78  # reflected Castagnoli
+
+_T = np.zeros(256, np.uint32)
+for _i in range(256):
+    _c = _i
+    for _ in range(8):
+        _c = (_POLY ^ (_c >> 1)) if (_c & 1) else (_c >> 1)
+    _T[_i] = _c
+
+
+def _advance(states: np.ndarray) -> np.ndarray:
+    """One zero-byte advance A8 applied to u32-encoded GF(2) states."""
+    return (states >> np.uint32(8)) ^ _T[states & np.uint32(0xFF)]
+
+
+@functools.lru_cache(maxsize=16)
+def _matrices(n: int, block: int) -> tuple[np.ndarray, np.ndarray, int]:
+    """(BlockMat (block*8, 32) int8, PowMat (K*32, 32) int8, affine u32)
+    for messages of exactly n bytes split into K = n/block blocks."""
+    assert n % block == 0 and n > 0
+    k_blocks = n // block
+    # columns for byte j, bit b inside ONE block: A8^(block-1-j)(T[1<<b])
+    base = _T[np.left_shift(1, np.arange(8))].astype(np.uint32)  # (8,)
+    cols = np.zeros((block, 8), np.uint32)
+    cur = base.copy()
+    for j in range(block - 1, -1, -1):
+        cols[j] = cur
+        cur = _advance(cur)
+    # BlockMat bits: (block*8, 32)
+    bm = ((cols.reshape(block * 8, 1)
+           >> np.arange(32, dtype=np.uint32)) & 1).astype(np.int8)
+    # block-advance operator C = A8^block as 32 u32 columns
+    c_cols = np.left_shift(np.uint32(1), np.arange(32, dtype=np.uint32))
+    for _ in range(block):
+        c_cols = _advance(c_cols)
+
+    def apply_c(v: np.ndarray) -> np.ndarray:
+        out = np.zeros_like(v)
+        for b in range(32):
+            out ^= np.where((v >> np.uint32(b)) & 1, c_cols[b],
+                            np.uint32(0))
+        return out
+
+    # PowMat: block m's state passes through C^(K-1-m); build backwards
+    pw = np.zeros((k_blocks, 32), np.uint32)
+    cur = np.left_shift(np.uint32(1), np.arange(32, dtype=np.uint32))
+    for m in range(k_blocks - 1, -1, -1):
+        pw[m] = cur
+        cur = apply_c(cur)
+    pm = ((pw.reshape(k_blocks * 32, 1)
+           >> np.arange(32, dtype=np.uint32)) & 1).astype(np.int8)
+    # affine part: A8^n(0xFFFFFFFF). After the loop above `cur` holds the
+    # columns of C^K = A8^n, and all-ones init means XOR of every column.
+    aff = np.bitwise_xor.reduce(cur)
+    return bm, pm, int(aff)
+
+
+def _pick_block(n: int) -> int:
+    for b in (256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if n % b == 0:
+            return b
+    return 1
+
+
+def crc32c_batch(data, block: int | None = None):
+    """CRC32C of every row of `data` ((B, n) uint8, device or host) as a
+    (B,) uint32 jax array. Bit-exact with util.crc32c.crc32c."""
+    import jax
+    import jax.numpy as jnp
+
+    data = jnp.asarray(data, jnp.uint8)
+    b_msgs, n = data.shape
+    blk = block or _pick_block(n)
+    bm, pm, aff = _matrices(n, blk)
+    k_blocks = n // blk
+
+    @jax.jit
+    def run(d):
+        # unpack bits LSB-first: (B, n) -> (B, n*8) int8 in {0,1}
+        bits = ((d[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1)
+        bits = bits.reshape(b_msgs, k_blocks, blk * 8).astype(jnp.int8)
+        y = jax.lax.dot_general(
+            bits, jnp.asarray(bm),
+            (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32) & 1      # (B, K, 32)
+        y = y.reshape(b_msgs, k_blocks * 32).astype(jnp.int8)
+        s = jax.lax.dot_general(
+            y, jnp.asarray(pm),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32) & 1      # (B, 32)
+        state = jnp.sum(
+            s.astype(jnp.uint32) << jnp.arange(32, dtype=jnp.uint32),
+            axis=-1, dtype=jnp.uint32)
+        return state ^ jnp.uint32(aff) ^ jnp.uint32(0xFFFFFFFF)
+
+    return run(data)

@@ -33,8 +33,11 @@ def main() -> None:
                  "buffer_mb": buffer_mb}
 
     import jax
-    import jax.numpy as jnp
 
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        # the axon sitecustomize force-registers the TPU tunnel no
+        # matter what JAX_PLATFORMS says; jax.config wins at init time
+        jax.config.update("jax_platforms", "cpu")
     out["backend"] = jax.default_backend()
     # tiny probe first: a wedged tunnel should fail here, not mid-run
     jax.device_put(np.ones(256, np.uint8)).block_until_ready()
