@@ -143,6 +143,7 @@ class VolumeServer:
         app.router.add_post("/admin/ec/generate_batch",
                             self.h_ec_generate_batch)
         app.router.add_post("/admin/ec/rebuild", self.h_ec_rebuild)
+        app.router.add_post("/admin/ec/verify", self.h_ec_verify)
         app.router.add_post("/admin/ec/mount", self.h_ec_mount)
         app.router.add_post("/admin/ec/unmount", self.h_ec_unmount)
         app.router.add_post("/admin/ec/copy", self.h_ec_copy)
@@ -1206,6 +1207,27 @@ class VolumeServer:
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=500)
         return web.json_response({"rebuilt": rebuilt})
+
+    async def h_ec_verify(self, req: web.Request) -> web.Response:
+        """Parity scrub of a mounted EC volume (EcVolume.verify_parity):
+        recomputes RS(10,4) parity for every stripe window through the
+        configured encoder (TPU when attached) and reports corrupt
+        window offsets. No reference RPC — its integrity checking stops
+        at per-needle CRC on read (needle/crc.go)."""
+        vid = int(req.query["volume"])
+        ev = self.store.ec_volumes.get(vid)
+        if ev is None:
+            return web.json_response({"error": f"ec volume {vid} not "
+                                      f"mounted"}, status=404)
+        window = int(req.query.get("windowMB", 4)) << 20
+        loop = asyncio.get_running_loop()
+        try:
+            report = await loop.run_in_executor(
+                None, lambda: ev.verify_parity(window))
+        except (OSError, EcVolumeError) as e:
+            return web.json_response({"error": str(e)}, status=500)
+        report["volume"] = vid
+        return web.json_response(report)
 
     async def h_ec_mount(self, req: web.Request) -> web.Response:
         vid = int(req.query["volume"])

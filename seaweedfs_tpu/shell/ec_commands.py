@@ -185,6 +185,40 @@ async def ec_shard_map(env: CommandEnv) -> dict[int, dict]:
     return out
 
 
+async def ec_verify(env: CommandEnv, collection: str = "",
+                    volume_id: int | None = None,
+                    window_mb: int = 4) -> list[dict]:
+    """Parity-scrub EC volumes cluster-wide: for each EC volume, ask a
+    shard-holding server to recompute RS(10,4) parity over every stripe
+    window (/admin/ec/verify -> EcVolume.verify_parity, TPU-backed when
+    a chip is attached) and report corrupt windows. A no-reference-
+    -equivalent capability: the reference's integrity checking stops at
+    per-needle CRCs on read (needle/crc.go)."""
+    results: list[dict] = []
+    for vid, info in sorted((await ec_shard_map(env)).items()):
+        if volume_id is not None and vid != volume_id:
+            continue
+        if collection and info["collection"] != collection:
+            continue
+        # the server holding the most shards verifies the most locally
+        counts: dict[str, int] = {}
+        for urls in info["shards"].values():
+            for u in urls:
+                counts[u] = counts.get(u, 0) + 1
+        if not counts:
+            continue
+        node = max(counts, key=counts.get)  # type: ignore[arg-type]
+        try:
+            report = await env.node_post(node, "/admin/ec/verify",
+                                         volume=str(vid),
+                                         windowMB=str(window_mb))
+        except RuntimeError as e:
+            report = {"volume": vid, "error": str(e)[:200]}
+        report["node"] = node
+        results.append(report)
+    return results
+
+
 async def ec_rebuild(env: CommandEnv, collection: str = "",
                      apply_changes: bool = True) -> list[dict]:
     """Rebuild every deficient EC volume (10 <= shards < 14); <10 shards is

@@ -17,6 +17,7 @@ from .env import CommandEnv
 HELP = """commands:
   ec.encode    [-collection c] [-volumeId n] [-fullPercent 95]
   ec.rebuild   [-collection c] [-force]
+  ec.verify    [-collection c] [-volumeId n] [-windowMB 4]
   ec.decode    [-collection c] [-volumeId n]
   ec.balance   [-collection c] [-force]
   volume.vacuum          [-garbageThreshold 0.3] [-collection c]
@@ -126,6 +127,12 @@ async def dispatch(env: CommandEnv, line: str) -> object:
         res = await ec.ec_encode(
             env, collection=flags.get("collection", ""), vids=vids,
             fullness=float(flags.get("fullPercent", 95)) / 100)
+    elif cmd == "ec.verify":
+        vid_s = flags.get("volumeId")
+        res = await ec.ec_verify(
+            env, collection=flags.get("collection", ""),
+            volume_id=int(vid_s) if vid_s else None,
+            window_mb=int(flags.get("windowMB", 4)))
     elif cmd == "ec.rebuild":
         res = await ec.ec_rebuild(
             env, collection=flags.get("collection", ""),
