@@ -51,3 +51,23 @@ def test_full_cycle_rebuild(eight_devices):
     for v in range(4):
         for j, sid in enumerate(lost):
             assert np.array_equal(rebuilt[v, j], encoded[v, sid]), (v, sid)
+
+
+def test_batched_verify_scrub(eight_devices):
+    m = pmesh.make_mesh(eight_devices)
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (5, 10, 1024)).astype(np.uint8)
+    encoded = np.asarray(pmesh.batched_encode(m, data))
+    clean = np.asarray(pmesh.batched_verify(m, encoded))
+    assert clean.tolist() == [0] * 5
+    # flip one byte in a data shard of volume 3: exactly the parity
+    # bytes of that column go inconsistent (4 parity rows -> count 4)
+    corrupt = encoded.copy()
+    corrupt[3, 2, 100] ^= 0x5A
+    bad = np.asarray(pmesh.batched_verify(m, corrupt))
+    assert bad[3] > 0 and all(bad[v] == 0 for v in range(5) if v != 3)
+    # a flipped PARITY byte is also caught, on the right volume
+    corrupt2 = encoded.copy()
+    corrupt2[1, 12, 7] ^= 1
+    bad2 = np.asarray(pmesh.batched_verify(m, corrupt2))
+    assert bad2[1] == 1 and bad2[3] == 0
