@@ -1241,6 +1241,9 @@ scripts = \"\"\"
   ec.rebuild -force
   ec.balance -force
   volume.balance -force
+  # parity scrub reads every EC stripe — run it on its own master.toml
+  # with a long sleep_minutes (e.g. daily), not every cycle:
+  # ec.verify -collection important
 \"\"\"
 sleep_minutes = 17
 [master.sequencer]
