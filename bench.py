@@ -732,5 +732,13 @@ def main() -> None:
 if __name__ == "__main__":
     if "--child" in sys.argv[1:]:
         child_main()
+    elif "--needle" in sys.argv[1:]:
+        # needle data-plane benchmark incl. the -workers sweep
+        # (tools/bench_needle.py; BENCH_NEEDLE.md documents results)
+        import runpy
+        sys.argv = [a for a in sys.argv if a != "--needle"]
+        runpy.run_path(os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools", "bench_needle.py"),
+            run_name="__main__")
     else:
         main()

@@ -35,6 +35,21 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="write tracemalloc top allocations here on exit")
 
 
+def _add_workers(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-workers", type=int, default=1,
+                   help="process-per-core data plane: N worker "
+                        "processes share the port via SO_REUSEPORT "
+                        "(volume: ownership partitioned vid %% N; "
+                        "master: worker 0 is the full master, the rest "
+                        "are /dir/assign accelerators)")
+    # internal: set by the supervisor when re-executing itself as a
+    # worker; never passed by operators
+    p.add_argument("-workerIndex", type=int, default=-1,
+                   help=argparse.SUPPRESS)
+    p.add_argument("-workerStateDir", default="",
+                   help=argparse.SUPPRESS)
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="weed-tpu",
                                  description=__doc__.split("\n")[0])
@@ -42,6 +57,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     m = sub.add_parser("master", help="start a master server")
     _add_common(m)
+    _add_workers(m)
     m.add_argument("-port", type=int, default=9333)
     m.add_argument("-volumeSizeLimitMB", type=int, default=30_000)
     m.add_argument("-defaultReplication", default="000")
@@ -75,6 +91,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     v = sub.add_parser("volume", help="start a volume server")
     _add_common(v)
+    _add_workers(v)
     v.add_argument("-port", type=int, default=8080)
     v.add_argument("-dir", default="./data", help="comma-separated dirs")
     v.add_argument("-max", default="8", help="comma-separated max volumes")
@@ -308,7 +325,11 @@ def build_parser() -> argparse.ArgumentParser:
 def _find_config_toml(name: str) -> tuple[str, dict] | None:
     """viper-style discovery of <name>.toml in ./, ~/.seaweedfs,
     /etc/seaweedfs (util/config.go:28-45); returns (path, parsed)."""
-    import tomllib
+    from .util.toml import tomllib
+    if tomllib is None:
+        # no TOML parser on this Python (tomllib is 3.11+): config
+        # discovery is disabled rather than every command crashing
+        return None
     for d in (".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs"):
         path = os.path.join(d, f"{name}.toml")
         if os.path.exists(path):
@@ -397,8 +418,91 @@ async def _serve_until_interrupt(*servers) -> None:
             glog.warning("shutdown of %s: %s", type(srv).__name__, e)
 
 
+def _worker_state_dir(args, kind: str) -> str:
+    if args.workerStateDir:
+        return args.workerStateDir
+    if kind == "volume":
+        return os.path.join(args.dir.split(",")[0], ".workers")
+    return os.path.join(args.mdir or ".", ".workers")
+
+
+async def _run_worker_supervisor(args, kind: str) -> None:
+    """Parent of `-workers N`: mint the launch token, spawn the worker
+    processes (this same command line + -workerIndex i), restart
+    crashed ones. No socket lives here — see server/workers.py."""
+    import secrets
+    from .server.workers import (Supervisor, WORKER_TOKEN_ENV,
+                                 fresh_state_dir)
+    if args.port == 0:
+        raise SystemExit(f"{kind} -workers needs an explicit -port "
+                         f"(the workers share it via SO_REUSEPORT)")
+    state_dir = fresh_state_dir(_worker_state_dir(args, kind))
+    env = dict(os.environ)
+    env[WORKER_TOKEN_ENV] = env.get(WORKER_TOKEN_ENV) \
+        or secrets.token_hex(16)
+    raw = list(getattr(args, "_raw_argv", None) or sys.argv[1:])
+
+    def build_argv(index: int) -> list[str]:
+        return ([sys.executable, "-m", "seaweedfs_tpu.cli"] + raw
+                + ["-workerIndex", str(index),
+                   "-workerStateDir", state_dir])
+
+    sup = Supervisor(build_argv, args.workers, env=env)
+    await sup.start()
+    print(f"{kind} supervisor: {args.workers} workers sharing port "
+          f"{args.port} (state: {state_dir})")
+    from .util.signals import wait_for_interrupt
+    await wait_for_interrupt()
+    await sup.stop()
+
+
+_BACKGROUND_TASKS: set = set()  # strong refs: loop tasks are weakly held
+
+
+def _watch_parent() -> None:
+    """Workers exit when the supervisor disappears (reparented to
+    init), so a SIGKILLed supervisor never leaks port-holding
+    orphans."""
+    ppid = os.getppid()
+
+    async def watch() -> None:
+        while os.getppid() == ppid:
+            await asyncio.sleep(1.0)
+        os._exit(0)
+
+    task = asyncio.get_running_loop().create_task(watch())
+    _BACKGROUND_TASKS.add(task)
+    task.add_done_callback(_BACKGROUND_TASKS.discard)
+
+
+def _make_worker_ctx(args, kind: str):
+    from .server.workers import WorkerContext
+    return WorkerContext(args.workerIndex, args.workers, args.port,
+                         _worker_state_dir(args, kind))
+
+
 async def _run_master(args) -> None:
     from .master.server import MasterServer
+    if args.workers > 1 and args.workerIndex < 0:
+        await _run_worker_supervisor(args, "master")
+        return
+    if args.workerIndex > 0:
+        # assign accelerator: shares the port, leases ids, proxies cold
+        from .server.workers import AssignAccelerator
+        _watch_parent()
+        acc = AssignAccelerator(
+            args.ip, args.port, _make_worker_ctx(args, "master"),
+            white_list=parse_white_list(args.whiteList),
+            jwt_key=args.jwtKey,
+            default_replication=args.defaultReplication)
+        await acc.start()
+        print(f"master assign worker {args.workerIndex} on {acc.url}")
+        await _serve_until_interrupt(acc)
+        return
+    worker_ctx = None
+    if args.workerIndex == 0:
+        _watch_parent()
+        worker_ctx = _make_worker_ctx(args, "master")
     toml_cfg = _load_master_toml()
     m = MasterServer(ip=args.ip, port=args.port,
                      volume_size_limit_mb=args.volumeSizeLimitMB,
@@ -418,7 +522,8 @@ async def _run_master(args) -> None:
                      admin_scripts_interval_s=toml_cfg.get(
                          "admin_scripts_interval_s", 17 * 60.0),
                      white_list=parse_white_list(args.whiteList),
-                     volume_preallocate=args.volumePreallocate)
+                     volume_preallocate=args.volumePreallocate,
+                     worker_ctx=worker_ctx)
     await m.start()
     if args.metricsGateway:
         from .stats.metrics import push_loop
@@ -430,6 +535,13 @@ async def _run_master(args) -> None:
 async def _run_volume(args) -> None:
     from .server.volume_server import VolumeServer
     from .storage.store import Store
+    if args.workers > 1 and args.workerIndex < 0:
+        await _run_worker_supervisor(args, "volume")
+        return
+    worker_ctx = None
+    if args.workerIndex >= 0:
+        _watch_parent()
+        worker_ctx = _make_worker_ctx(args, "volume")
     dirs = args.dir.split(",")
     maxes = [int(x) for x in args.max.split(",")]
     if len(maxes) == 1:
@@ -449,14 +561,22 @@ async def _run_volume(args) -> None:
     store = Store(dirs, max_volume_counts=maxes,
                   compaction_bytes_per_second=args.compactionMBps
                   * 1024 * 1024,
-                  index_type=args.index)
+                  index_type=args.index,
+                  partition=(None if worker_ctx is None else
+                             (worker_ctx.index, worker_ctx.total)))
     vs = VolumeServer(store, args.master, ip=args.ip, port=args.port,
                       data_center=args.dataCenter, rack=args.rack,
                       pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
                       white_list=parse_white_list(args.whiteList),
-                      public_url=args.publicUrl)
+                      public_url=args.publicUrl,
+                      worker_ctx=worker_ctx)
     await vs.start()
-    print(f"volume server listening on {vs.url}, dirs={dirs}")
+    if worker_ctx is not None:
+        print(f"volume worker {worker_ctx.index}/{worker_ctx.total}: "
+              f"public {args.ip}:{worker_ctx.public_port}, "
+              f"private {vs.url}, dirs={dirs}")
+    else:
+        print(f"volume server listening on {vs.url}, dirs={dirs}")
     await _serve_until_interrupt(vs)
 
 
@@ -1321,6 +1441,9 @@ def _discover_security_toml() -> None:
 
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
+    # the worker supervisor re-executes this same command line with
+    # -workerIndex appended; remember it when given programmatically
+    args._raw_argv = list(argv) if argv is not None else None
     # SWTPU_OFFSET_BYTES=5: the reference's 5BytesOffset build tag as a
     # runtime switch (8TB volumes; offset_5bytes.go:14-16). Process-wide,
     # set before any volume or index file is opened.

@@ -285,6 +285,10 @@ class Election:
         if term > self.term:
             self.voted_for = None
             self.term = term
+            # persist NOW, even when the snapshot turns out stale below:
+            # currentTerm durability must not depend on installation, or
+            # a restart forgets the bump and this node can double-vote
+            self._persist()
         self.leader = leader
         self._step_down()
         self.last_pulse = time.monotonic()

@@ -45,7 +45,12 @@ class MasterServer:
                  admin_scripts: list[str] | None = None,
                  admin_scripts_interval_s: float = 17 * 60.0,
                  white_list: list[str] | None = None,
-                 volume_preallocate: bool = False):
+                 volume_preallocate: bool = False,
+                 worker_ctx=None):
+        # -workers N (server/workers.py): this master is the PRIMARY
+        # (worker 0) of a fleet whose other members are assign
+        # accelerators sharing the public port via SO_REUSEPORT
+        self.worker_ctx = worker_ctx
         from ..security.guard import Guard
         # -whiteList: IP guard on the API surface (guard.go:43-137,
         # wrapped handlers at master_server.go:110-120)
@@ -124,6 +129,28 @@ class MasterServer:
         admit them on guarded paths regardless of -whiteList."""
         return ip is not None and ip in self._peer_ips
 
+    def _remote(self, req: web.Request) -> str | None:
+        """The peer IP a policy decision should see: for an intra-host
+        worker hop (launch-token authenticated) the accelerator's
+        X-Forwarded-For carries the real client address."""
+        wc = self.worker_ctx
+        if wc is not None:
+            from ..server import workers as wk
+            if wc.token_ok(req.headers.get(wk.WORKER_HEADER)):
+                return req.headers.get(wk.FORWARDED_HEADER) or req.remote
+        return req.remote
+
+    def _worker_auth(self, req: web.Request) -> bool:
+        """Gate on the internal mesh endpoints (/cluster/seq_lease,
+        /cluster/assign_state): when a worker token is configured only
+        fleet members holding it get in; a standalone master leaves
+        them open like the rest of the /cluster mesh (mTLS-scoped)."""
+        wc = self.worker_ctx
+        if wc is None or not wc.token:
+            return True
+        from ..server import workers as wk
+        return wc.token_ok(req.headers.get(wk.WORKER_HEADER))
+
     def _build_app(self) -> web.Application:
         from ..security.guard import middleware as guard_mw
         from ..security.guard import path_guarded
@@ -133,13 +160,17 @@ class MasterServer:
                 lambda: self.guard,
                 lambda req: (path_guarded(req.path, self._GUARDED)
                              and not (req.path == "/dir/lookup"
-                                      and self._is_peer(req.remote))))])
+                                      and self._is_peer(
+                                          self._remote(req)))),
+                remote_of=self._remote)])
         app.router.add_route("*", "/dir/assign", self.h_assign)
         app.router.add_route("*", "/dir/lookup", self.h_lookup)
         app.router.add_get("/dir/status", self.h_dir_status)
         app.router.add_get("/cluster/status", self.h_cluster_status)
         app.router.add_post("/cluster/heartbeat", self.h_heartbeat)
         app.router.add_get("/cluster/watch", self.h_watch)
+        app.router.add_get("/cluster/seq_lease", self.h_seq_lease)
+        app.router.add_get("/cluster/assign_state", self.h_assign_state)
         app.router.add_get("/stats/health", self.h_health)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_route("*", "/vol/grow", self.h_grow)
@@ -171,11 +202,27 @@ class MasterServer:
         # public listener: /dir/assign answered straight off the socket,
         # everything else upgrades in place onto the aiohttp app
         from ..server.fasthttp import FastAssignProtocol
-        self._server = await asyncio.get_running_loop().create_server(
+        loop = asyncio.get_running_loop()
+        wc = self.worker_ctx
+        self._server = await loop.create_server(
             lambda: FastAssignProtocol(self), self.ip, self.port,
-            ssl=tls.server_ctx(), reuse_address=True)
+            ssl=tls.server_ctx(), reuse_address=True,
+            reuse_port=wc is not None)
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
+        if wc is not None:
+            # a private listener is the direct door to THIS process for
+            # the assign accelerators (lease/assign-state/proxy target).
+            # Plain aiohttp, NOT the raw fast path: a proxied
+            # /dir/assign must be guarded against the forwarded client
+            # IP (token + X-Forwarded-For via _remote()), which the
+            # header-blind raw protocol cannot see — it would judge the
+            # accelerator's loopback address instead
+            self._priv_server = await loop.create_server(
+                self._runner.server, self.ip, 0,
+                ssl=tls.server_ctx(), reuse_address=True)
+            priv_port = self._priv_server.sockets[0].getsockname()[1]
+            wc.write_state(ip=self.ip, port=priv_port, role="master")
         self.election = Election(
             self.url, self._peers,
             election_timeout=self._election_timeout,
@@ -205,6 +252,8 @@ class MasterServer:
             # NOT wait_closed() (3.12 waits on live keep-alives)
             for tr in list(getattr(self, "_fast_conns", ())):
                 tr.close()
+        if getattr(self, "_priv_server", None) is not None:
+            self._priv_server.close()
         if self._runner:
             await self._runner.cleanup()
 
@@ -327,8 +376,6 @@ class MasterServer:
                             content_type="text/plain")
 
     async def h_heartbeat(self, req: web.Request) -> web.Response:
-        if req.remote:
-            self._peer_ips.add(req.remote)
         if not self.is_leader:
             # volume servers must register with the leader; hand back the
             # hint so they chase it (master_grpc_server.go:165-175)
@@ -337,7 +384,23 @@ class MasterServer:
         from ..stats import metrics
         if metrics.HAVE_PROMETHEUS:
             metrics.MASTER_RECEIVED_HEARTBEATS.inc()
-        hb = pb.Heartbeat.from_dict(await req.json())
+        try:
+            hb = pb.Heartbeat.from_dict(await req.json())
+        except (ValueError, TypeError, KeyError, AttributeError):
+            return web.json_response({"error": "bad heartbeat body"},
+                                     status=400)
+        if not hb.ip or not hb.port:
+            return web.json_response(
+                {"error": "heartbeat without ip:port"}, status=400)
+        # auto-admit the sender as a cluster peer ONLY now that the body
+        # parsed as a real volume-server registration on the leader path
+        # — an empty POST must not whitelist-bypass /dir/lookup. Residual
+        # exposure: a client that forges a full valid heartbeat is still
+        # admitted (and registered); the mesh trust boundary without
+        # security.toml mTLS is the heartbeat body, as in the reference.
+        remote = self._remote(req)
+        if remote:
+            self._peer_ips.add(remote)
         node = self.topo.register_heartbeat(hb)
         self.seq.set_max(hb.max_file_key)
         self._refresh_writable(node)
@@ -355,6 +418,51 @@ class MasterServer:
             "volume_size_limit": self.volume_size_limit,
             "leader": self.url,
         })
+
+    async def h_seq_lease(self, req: web.Request) -> web.Response:
+        """Lease a block of file ids to an assign accelerator
+        (server/workers.py): the accelerator hands them out without a
+        round trip per assign. Ids in an abandoned lease are simply
+        never used — file keys are sparse by design."""
+        if not self._worker_auth(req):
+            return web.json_response({"error": "forbidden"}, status=403)
+        if not self.is_leader:
+            return web.json_response(
+                {"error": "not leader", "leader": self.leader_url or ""},
+                status=503)
+        try:
+            count = max(1, min(int(req.query.get("count", 1024)),
+                               1 << 20))
+        except ValueError:
+            return web.json_response({"error": "bad count"}, status=400)
+        return web.json_response(
+            {"start": self.seq.next_file_id(count), "count": count})
+
+    async def h_assign_state(self, req: web.Request) -> web.Response:
+        """Writable-volume snapshot for one layout key — everything an
+        accelerator needs to answer /dir/assign locally: vids with
+        enough live replicas plus their primary location."""
+        if not self._worker_auth(req):
+            return web.json_response({"error": "forbidden"}, status=403)
+        if not self.is_leader:
+            return web.json_response({"entries": [],
+                                      "leader": self.leader_url or ""})
+        q = req.query
+        collection = q.get("collection", "")
+        replication = q.get("replication", "") or self.default_replication
+        ttl = q.get("ttl", "")
+        try:
+            rp = ReplicaPlacement.parse(replication)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        lay = self._layout(collection, replication, ttl)
+        entries = []
+        for vid in sorted(lay.writable):
+            nodes = self.topo.lookup(vid)
+            if len(nodes) >= rp.copy_count:
+                entries.append({"vid": vid, "url": nodes[0].url,
+                                "publicUrl": nodes[0].public_url})
+        return web.json_response({"entries": entries})
 
     async def h_assign(self, req: web.Request) -> web.Response:
         if not self.is_leader:

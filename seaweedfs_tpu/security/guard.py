@@ -44,16 +44,19 @@ class Guard:
         return addr in self.ips or any(addr in net for net in self.nets)
 
 
-def middleware(guard_getter, is_guarded):
+def middleware(guard_getter, is_guarded, remote_of=None):
     """Shared aiohttp middleware: 401 when the live guard rejects the
     peer of a guarded request. guard_getter is late-bound so a server's
-    guard can be swapped at runtime (tests do)."""
+    guard can be swapped at runtime (tests do). remote_of lets -workers
+    servers substitute the token-authenticated X-Forwarded-For peer for
+    intra-host proxy hops (server/workers.py)."""
     from aiohttp import web
 
     @web.middleware
     async def white_list_mw(req, handler):
         g = guard_getter()
-        if not g.empty and is_guarded(req) and not g.allows(req.remote):
+        remote = remote_of(req) if remote_of is not None else req.remote
+        if not g.empty and is_guarded(req) and not g.allows(remote):
             return web.json_response({"error": "ip not in whitelist"},
                                      status=401)
         return await handler(req)

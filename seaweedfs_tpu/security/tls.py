@@ -46,7 +46,11 @@ def configure_from_toml(path: str, cfg: dict | None = None) -> bool:
     file is already parsed); returns True if TLS was enabled.
     Absent/empty section leaves plaintext HTTP."""
     if cfg is None:
-        import tomllib
+        from ..util.toml import tomllib
+        if tomllib is None:
+            raise SystemExit(
+                "security.toml given but no TOML parser available "
+                "(tomllib needs Python 3.11+, or install tomli)")
         with open(path, "rb") as f:
             cfg = tomllib.load(f)
     tls = cfg.get("tls", {})

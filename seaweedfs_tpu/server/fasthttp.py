@@ -44,10 +44,13 @@ _R404 = (b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")
 _R404_VOL = (b"HTTP/1.1 404 Not Found\r\n"
              b"Content-Type: application/json; charset=utf-8\r\n"
              b"Content-Length: 22\r\n\r\n{\"error\": \"not found\"}")
+_R401_BODY = b"{\"error\": \"ip not in whitelist\"}"
+# built from len(): a hand-counted Content-Length that disagrees with
+# the body desyncs every spec-conformant keep-alive client
 _R401_IP = (b"HTTP/1.1 401 Unauthorized\r\n"
             b"Content-Type: application/json; charset=utf-8\r\n"
-            b"Content-Length: 33\r\n\r\n"
-            b"{\"error\": \"ip not in whitelist\"}\r\n"[:-2])
+            b"Content-Length: " + str(len(_R401_BODY)).encode()
+            + b"\r\n\r\n" + _R401_BODY)
 _R400 = (b"HTTP/1.1 400 Bad Request\r\nContent-Length: 0\r\n\r\n")
 
 # tiny cache of formatted Last-Modified values: needles written in the
@@ -78,7 +81,8 @@ def _json_err(status: int, reason: str, msg: str) -> bytes:
 class FastNeedleProtocol(asyncio.Protocol):
     """Per-connection fast parser; upgrades to aiohttp on anything cold."""
 
-    __slots__ = ("vs", "buf", "transport", "peer_ip", "_busy", "_closed")
+    __slots__ = ("vs", "buf", "transport", "peer_ip", "_busy", "_closed",
+                 "_task")
 
     def __init__(self, vs) -> None:
         self.vs = vs
@@ -87,6 +91,7 @@ class FastNeedleProtocol(asyncio.Protocol):
         self.peer_ip: str | None = None
         self._busy = False        # an async handler owns the buffer head
         self._closed = False
+        self._task: asyncio.Task | None = None
 
     # -- asyncio.Protocol --
 
@@ -143,9 +148,7 @@ class FastNeedleProtocol(asyncio.Protocol):
                     return
                 fid_s = m.group(2).decode()
                 del self.buf[:head_end + 4]
-                self._busy = True
-                asyncio.get_running_loop().create_task(
-                    self._do_get(fid_s, headers))
+                self._spawn(self._do_get(fid_s, headers))
                 return
             # POST/PUT
             if not self._write_is_fast(m, headers):
@@ -158,10 +161,33 @@ class FastNeedleProtocol(asyncio.Protocol):
             body = bytes(self.buf[head_end + 4:total])
             fid_s = m.group(2).decode()
             del self.buf[:total]
-            self._busy = True
-            asyncio.get_running_loop().create_task(
-                self._do_post(fid_s, m.group(3), headers, body))
+            self._spawn(self._do_post(fid_s, m.group(3), headers, body))
             return
+
+    def _spawn(self, coro) -> None:
+        """Run an async handler for the request at the buffer head.
+        The task handle is retained (an unreferenced asyncio task may be
+        garbage-collected mid-flight) and a done-callback closes the
+        connection if the handler died before answering — otherwise
+        `_busy` would stay set and the connection would wedge silently."""
+        self._busy = True
+        self._task = asyncio.get_running_loop().create_task(coro)
+        self._task.add_done_callback(self._handler_done)
+
+    def _handler_done(self, task: asyncio.Task) -> None:
+        if self._task is task:
+            # _finish -> _pump may already have spawned the NEXT
+            # request's task; never clobber that newer reference
+            self._task = None
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None and not self._closed:
+            # no response was written for the consumed request: the
+            # stream is desynced, closing is the only coherent answer
+            self._closed = True
+            self._busy = False
+            self.transport.close()
 
     def _parse_headers(self, head_end: int, line_end: int
                        ) -> dict[str, str] | None:
@@ -220,6 +246,12 @@ class FastNeedleProtocol(asyncio.Protocol):
         except ValueError as e:
             self._finish(_json_err(400, "Bad Request", str(e)))
             return
+        wc = vs.worker_ctx
+        if wc is not None and not wc.owns(fid.volume_id):
+            # a sibling worker's partition: replay through aiohttp,
+            # whose worker-routing middleware proxies to the owner
+            self._upgrade_replay(b"GET", fid_s, headers)
+            return
         if not vs.store.has_volume(fid.volume_id):
             if vs.read_redirect:
                 self._upgrade_replay(b"GET", fid_s, headers)
@@ -277,13 +309,22 @@ class FastNeedleProtocol(asyncio.Protocol):
     async def _do_post(self, fid_s: str, q: bytes,
                        headers: dict[str, str], body: bytes) -> None:
         vs = self.vs
-        if not vs.guard.empty and not vs.guard.allows(self.peer_ip):
+        wc = vs.worker_ctx
+        # an intra-host worker hop carries the launch token: the entry
+        # worker already ran the guard against the real client IP
+        proxied_hop = wc is not None and \
+            wc.token_ok(headers.get("x-swtpu-worker"))
+        if not proxied_hop and not vs.guard.empty \
+                and not vs.guard.allows(self.peer_ip):
             self._finish(_R401_IP)
             return
         try:
             fid = t.FileId.parse(fid_s)
         except ValueError as e:
             self._finish(_json_err(400, "Bad Request", str(e)))
+            return
+        if wc is not None and not wc.owns(fid.volume_id):
+            self._upgrade_replay(b"POST", fid_s, headers, q, body)
             return
         # replication fan-out stays with aiohttp: decide BEFORE writing
         v = vs.store.volumes.get(fid.volume_id)
@@ -501,3 +542,18 @@ class FastAssignProtocol(asyncio.Protocol):
         proto.connection_made(self.transport)
         if raw:
             proto.data_received(raw)
+
+
+class AcceleratorAssignProtocol(FastAssignProtocol):
+    """Raw listener of a master assign-accelerator worker
+    (server/workers.py AssignAccelerator): identical wire discipline to
+    FastAssignProtocol (the `ms` slot holds the accelerator, which
+    exposes the same `_runner`/`_fast_conns` surface), but the assign
+    decision comes from the accelerator's leased ids + writable-set
+    snapshot instead of the live topology, and a cold request upgrades
+    onto the accelerator's transparent proxy app."""
+
+    __slots__ = ()
+
+    def _assign(self, q: bytes) -> bytes | None:
+        return self.ms.fast_assign(q, self.peer_ip)

@@ -14,6 +14,7 @@ import asyncio
 import gzip
 import json
 import os
+import re
 import time
 
 import aiohttp
@@ -56,6 +57,26 @@ def _guess_mime(fname: str, default: str) -> str:
     return guess if guess and enc is None else default
 
 
+def _wk():
+    """Lazy server.workers import (only -workers mode pays for it)."""
+    from . import workers
+    return workers
+
+
+_FID_PATH = re.compile(r"^/(\d+),")
+
+
+def _request_vid(req: "web.Request") -> int | None:
+    """Volume id a request targets, for worker-partition routing:
+    needle paths (`/<vid>,<fid>`) and admin routes carrying a
+    volume/volumeId query param."""
+    m = _FID_PATH.match(req.path)
+    if m:
+        return int(m.group(1))
+    v = req.query.get("volume", "") or req.query.get("volumeId", "")
+    return int(v) if v.isdigit() else None
+
+
 class VolumeServer:
     def __init__(self, store: Store, master_url: str,
                  ip: str = "127.0.0.1", port: int = 8080,
@@ -64,7 +85,12 @@ class VolumeServer:
                  read_redirect: bool = True,
                  jwt_key: str = "",
                  white_list: list[str] | None = None,
-                 public_url: str = ""):
+                 public_url: str = "",
+                 worker_ctx=None):
+        # -workers N process-per-core mode (server/workers.py): this
+        # server is worker `ctx.index` of `ctx.total`, sharing the
+        # public port via SO_REUSEPORT and owning vids % total == index
+        self.worker_ctx = worker_ctx
         self.public_url = public_url
         from ..security.guard import Guard
         # -whiteList (volume.go:87,125): IP guard over the admin surface
@@ -112,18 +138,47 @@ class VolumeServer:
         # be whitelisted
         if req.method not in ("POST", "PUT", "DELETE"):
             return False
+        if self.worker_ctx is not None and self.worker_ctx.token_ok(
+                req.headers.get(_wk().WORKER_HEADER)):
+            # intra-host worker hop: the entry worker already ran the
+            # guard against the real client IP before proxying
+            return False
         if req.path.startswith("/admin/") and tls.server_ctx() is not None:
             return False
         if req.query.get("type") == "replicate" and self.jwt_key:
             return False
         return True
 
+    @web.middleware
+    async def _worker_route_mw(self, req: web.Request, handler):
+        """-workers partition routing: a request for a volume owned by
+        a sibling worker is proxied to that sibling's private listener.
+        Runs AFTER the guard middleware so the entry worker enforces
+        the whitelist against the real client IP; the hop itself is
+        authenticated by the launch token (never re-proxied)."""
+        wk = _wk()
+        wc = self.worker_ctx
+        if wc is None or wc.token_ok(req.headers.get(wk.WORKER_HEADER)):
+            return await handler(req)
+        vid = _request_vid(req)
+        if vid is None or wc.owns(vid):
+            return await handler(req)
+        target = wc.owner_addr(vid)
+        if target is None:
+            return web.json_response(
+                {"error": f"worker {wc.owner_index(vid)} (owner of "
+                          f"volume {vid}) unavailable"}, status=503)
+        return await wk.proxy_request(req, self._http, target, wc.token)
+
     def _build_app(self) -> web.Application:
         from ..security.guard import middleware as guard_mw
+        middlewares = [guard_mw(lambda: self.guard,
+                                self._guarded_request)]
+        if self.worker_ctx is not None:
+            middlewares.append(self._worker_route_mw)
         app = web.Application(
             client_max_size=1024 * 1024 * 1024,
-            middlewares=[guard_mw(lambda: self.guard,
-                                  self._guarded_request)])
+            middlewares=middlewares)
         # admin API (gRPC-analog)
         app.router.add_post("/admin/volume/allocate", self.h_allocate)
         app.router.add_post("/admin/volume/delete", self.h_volume_delete)
@@ -157,6 +212,7 @@ class VolumeServer:
         app.router.add_post("/admin/tier/download", self.h_tier_download)
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", self.h_metrics)
+        app.router.add_get("/stats/workers", self.h_stats_workers)
         app.router.add_get("/ui", self.h_ui)
         # public needle API — catch-all LAST
         app.router.add_route("GET", "/{fid:[^/]+}", self.h_get)
@@ -184,10 +240,24 @@ class VolumeServer:
         # (fasthttp.py); cold requests upgrade in place onto the aiohttp
         # app served by self._runner
         from .fasthttp import FastNeedleProtocol
-        self._server = await asyncio.get_running_loop().create_server(
-            lambda: FastNeedleProtocol(self), self.ip, self.port,
-            ssl=tls.server_ctx(), reuse_address=True)
-        if self.port == 0:
+        loop = asyncio.get_running_loop()
+        wc = self.worker_ctx
+        self._server = await loop.create_server(
+            lambda: FastNeedleProtocol(self), self.ip,
+            wc.public_port if wc is not None else self.port,
+            ssl=tls.server_ctx(), reuse_address=True,
+            reuse_port=wc is not None)
+        if wc is not None:
+            # worker mode: the shared SO_REUSEPORT port is the public
+            # face; a second private listener is this worker's identity
+            # — the master registers it as its own node, so
+            # master-directed traffic goes straight to the owner and
+            # siblings/supervisor can address this worker specifically
+            self._priv_server = await loop.create_server(
+                lambda: FastNeedleProtocol(self), self.ip, 0,
+                ssl=tls.server_ctx(), reuse_address=True)
+            self.port = self._priv_server.sockets[0].getsockname()[1]
+        elif self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
         self.store.ip = self.ip
         self.store.port = self.port
@@ -195,9 +265,13 @@ class VolumeServer:
             # -publicUrl (volume.go:60): the externally reachable
             # address advertised in heartbeats/locations
             self.store.public_url = self.public_url
+        elif wc is not None:
+            self.store.public_url = f"{self.ip}:{wc.public_port}"
         elif not self.store.public_url or \
                 self.store.public_url.endswith(":0"):
             self.store.public_url = self.url
+        if wc is not None:
+            wc.write_state(ip=self.ip, port=self.port, role="volume")
         # remote EC shard reads run inside executor threads, so they use a
         # synchronous client (readRemoteEcShardInterval, store_ec.go:211+)
         self.store.fetch_remote_shard = self._sync_fetch_remote_shard
@@ -214,6 +288,8 @@ class VolumeServer:
             # keep-alive connection; drop fast-path transports directly
             for tr in list(getattr(self, "_fast_conns", ())):
                 tr.close()
+        if getattr(self, "_priv_server", None) is not None:
+            self._priv_server.close()
         if self._runner:
             await self._runner.cleanup()
         self.store.close()
@@ -779,8 +855,58 @@ class VolumeServer:
             return {"fileId": fid_s, "status": 202, "size": size}
 
         loop = asyncio.get_running_loop()
-        results = await loop.run_in_executor(
-            None, lambda: [one(f) for f in fids])
+        wc = self.worker_ctx
+        if wc is None or self._is_worker_hop(req):
+            results = await loop.run_in_executor(
+                None, lambda: [one(f) for f in fids])
+            return web.json_response({"results": results})
+        # -workers: a batch spans partitions — split by owning worker,
+        # delete the local group here, forward each sibling its group,
+        # and reassemble results in request order
+        import aiohttp
+        groups: dict[int, list] = {}
+        for f in fids:
+            try:
+                idx = wc.owner_index(int(str(f).split(",")[0]))
+            except ValueError:
+                idx = wc.index       # malformed: local path 400s it
+            groups.setdefault(idx, []).append(f)
+        by_fid: dict[str, dict] = {}
+        local = groups.pop(wc.index, [])
+        for r in await loop.run_in_executor(
+                None, lambda: [one(f) for f in local]):
+            by_fid[r["fileId"]] = r
+
+        async def forward(idx: int, group: list) -> None:
+            addr = wc.sibling_addr(idx)
+            sub = {"fileIds": group,
+                   "tokens": {str(f): tokens[str(f)] for f in group
+                              if str(f) in tokens}}
+            rows = None
+            if addr is not None:
+                try:
+                    async with self._http.post(
+                            tls.url(addr, "/admin/batch_delete"),
+                            json=sub,
+                            headers={_wk().WORKER_HEADER: wc.token},
+                            timeout=aiohttp.ClientTimeout(
+                                total=30)) as resp:
+                        if resp.status == 200:
+                            rows = (await resp.json())["results"]
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError, ValueError, KeyError):
+                    rows = None
+            if rows is None:
+                rows = [{"fileId": str(f), "status": 503,
+                         "error": f"worker {idx} unavailable"}
+                        for f in group]
+            for r in rows:
+                by_fid[r["fileId"]] = r
+
+        await asyncio.gather(*(forward(i, g) for i, g in groups.items()))
+        results = [by_fid.get(str(f),
+                              {"fileId": str(f), "status": 500,
+                               "error": "no result"}) for f in fids]
         return web.json_response({"results": results})
 
     async def _ec_delete_broadcast(self, vid: int, fid: str,
@@ -851,19 +977,93 @@ class VolumeServer:
 
     # ---- admin handlers ----
 
+    def _is_worker_hop(self, req: web.Request) -> bool:
+        wc = self.worker_ctx
+        return wc is not None and \
+            wc.token_ok(req.headers.get(_wk().WORKER_HEADER))
+
+    async def _sibling_get(self, path: str) -> "list[tuple[int, bytes]]":
+        """Fetch `path` from every live sibling worker (token-marked so
+        they answer locally instead of re-aggregating)."""
+        import aiohttp
+        wc = self.worker_ctx
+        out: list[tuple[int, bytes]] = []
+
+        async def one(i: int) -> None:
+            addr = wc.sibling_addr(i)
+            if addr is None:
+                return
+            try:
+                async with self._http.get(
+                        tls.url(addr, path),
+                        headers={_wk().WORKER_HEADER: wc.token},
+                        timeout=aiohttp.ClientTimeout(total=3)) as r:
+                    if r.status == 200:
+                        out.append((i, await r.read()))
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                pass
+
+        await asyncio.gather(*(one(i) for i in range(wc.total)
+                               if i != wc.index))
+        return out
+
     async def h_metrics(self, req: web.Request) -> web.Response:
-        from ..stats.metrics import metrics_text
-        return web.Response(body=metrics_text(),
+        """/metrics; under -workers, any worker answers for the whole
+        host by summing its siblings' registries, so scrapers keep one
+        whole-host target on the shared public port."""
+        from ..stats.metrics import merge_metrics_texts, metrics_text
+        if self.worker_ctx is None or self._is_worker_hop(req):
+            return web.Response(body=metrics_text(),
+                                content_type="text/plain")
+        texts = [metrics_text()]
+        texts += [body for _, body in await self._sibling_get("/metrics")]
+        return web.Response(body=merge_metrics_texts(texts),
                             content_type="text/plain")
 
     async def h_status(self, req: web.Request) -> web.Response:
         vols = [self.store._volume_message(v).to_dict()
                 for v in self.store.volumes.values()]
-        return web.json_response({
-            "version": "seaweedfs_tpu 0.1", "volumes": vols,
-            "ecVolumes": {vid: sorted(ev.shards)
-                          for vid, ev in self.store.ec_volumes.items()},
-        })
+        ec = {vid: sorted(ev.shards)
+              for vid, ev in self.store.ec_volumes.items()}
+        out = {"version": "seaweedfs_tpu 0.1", "volumes": vols,
+               "ecVolumes": ec}
+        wc = self.worker_ctx
+        if wc is not None and not self._is_worker_hop(req):
+            # whole-host view: fold in every sibling's partition
+            out["workers"] = wc.total
+            out["worker"] = wc.index
+            for _, body in await self._sibling_get("/status"):
+                try:
+                    sib = json.loads(body)
+                except ValueError:
+                    continue
+                vols.extend(sib.get("volumes", []))
+                ec.update(sib.get("ecVolumes", {}))
+            vols.sort(key=lambda m: m.get("id", 0))
+        return web.json_response(out)
+
+    async def h_stats_workers(self, req: web.Request) -> web.Response:
+        """Worker-fleet view: one row per configured worker slot, from
+        the shared state files (works no matter which worker answers)."""
+        wc = self.worker_ctx
+        if wc is None:
+            return web.json_response({"workers": [], "total": 1})
+        rows = []
+        for i, st in enumerate(wc.all_states()):
+            row = {"index": i, "alive": False}
+            if st:
+                row.update({k: st[k] for k in
+                            ("pid", "ip", "port", "public_port", "role")
+                            if k in st})
+                try:
+                    os.kill(st["pid"], 0)
+                    row["alive"] = True
+                except (OSError, KeyError):
+                    pass
+            if i == wc.index:
+                row["volumes"] = sorted(self.store.volumes)
+            rows.append(row)
+        return web.json_response({"workers": rows, "total": wc.total})
 
     async def h_ui(self, req: web.Request) -> web.Response:
         """Live volume status page (server/volume_server_ui/)."""
@@ -1173,6 +1373,56 @@ class VolumeServer:
         rack-encode shape; pipeline.write_ec_files_batched)."""
         vids = [int(x) for x in req.query["volumes"].split(",") if x]
         collection = req.query.get("collection", "")
+        wc = self.worker_ctx
+        if wc is not None and not self._is_worker_hop(req):
+            # split the batch across owning workers; each owner still
+            # batches ITS volumes through one kernel launch
+            import aiohttp
+            mine = [v for v in vids if wc.owns(v)]
+            failed: list[str] = []
+
+            async def forward(idx: int, group: list[int]) -> None:
+                addr = wc.sibling_addr(idx)
+                try:
+                    if addr is None:
+                        raise OSError(f"worker {idx} unavailable")
+                    async with self._http.post(
+                            tls.url(addr, "/admin/ec/generate_batch"),
+                            params={"volumes": ",".join(map(str, group)),
+                                    "collection": collection},
+                            headers={_wk().WORKER_HEADER: wc.token},
+                            timeout=aiohttp.ClientTimeout(
+                                total=600)) as resp:
+                        if resp.status != 200:
+                            failed.append(await resp.text())
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError) as e:
+                    failed.append(str(e))
+
+            groups: dict[int, list[int]] = {}
+            for v in vids:
+                if not wc.owns(v):
+                    groups.setdefault(wc.owner_index(v), []).append(v)
+            jobs = [forward(i, g) for i, g in groups.items()]
+            if mine:
+                from multidict import CIMultiDict
+                h = CIMultiDict(req.headers)
+                h[_wk().WORKER_HEADER] = wc.token
+                sub = req.clone(
+                    rel_url=req.rel_url.update_query(
+                        volumes=",".join(map(str, mine))),
+                    headers=h)
+                jobs.append(self.h_ec_generate_batch(sub))
+            done = await asyncio.gather(*jobs, return_exceptions=True)
+            for d in done:
+                if isinstance(d, Exception):
+                    failed.append(str(d))
+                elif isinstance(d, web.Response) and d.status != 200:
+                    failed.append(d.text or "")
+            if failed:
+                return web.json_response(
+                    {"error": "; ".join(failed)}, status=502)
+            return web.json_response({"ok": True, "volumes": vids})
         bases = []
         for vid in vids:
             v = self.store.volumes.get(vid)

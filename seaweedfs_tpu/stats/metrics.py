@@ -53,6 +53,50 @@ else:  # pragma: no cover
         return b"# prometheus_client unavailable\n"
 
 
+def merge_metrics_texts(texts: "list[bytes]") -> bytes:
+    """Sum Prometheus text expositions from several worker processes
+    into one whole-host view (server/workers.py: each -workers worker
+    has its own registry; any worker answers /metrics for all).
+
+    Counters, gauges, and histogram buckets are summed per
+    (name, labels); `*_created` timestamps take the min (first birth);
+    HELP/TYPE comments are kept from their first appearance."""
+    order: list[tuple[str, bytes]] = []   # ("comment"|"sample", key)
+    seen_comments: set[bytes] = set()
+    sums: dict[bytes, float] = {}
+    for text in texts:
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith(b"#"):
+                if line not in seen_comments:
+                    seen_comments.add(line)
+                    order.append(("comment", line))
+                continue
+            i = line.rfind(b" ")
+            if i <= 0:
+                continue
+            key, raw = line[:i], line[i + 1:]
+            try:
+                val = float(raw)
+            except ValueError:
+                continue
+            if key not in sums:
+                order.append(("sample", key))
+                sums[key] = val
+            elif key.split(b"{", 1)[0].endswith(b"_created"):
+                sums[key] = min(sums[key], val)
+            else:
+                sums[key] += val
+    out = []
+    for kind, item in order:
+        if kind == "comment":
+            out.append(item)
+        else:
+            out.append(item + b" " + repr(sums[item]).encode())
+    return b"\n".join(out) + b"\n" if out else b""
+
+
 async def push_loop(gateway: str, job: str,
                     interval_seconds: float = 15.0) -> None:
     """LoopPushingMetric (metrics.go:109-137)."""

@@ -34,9 +34,17 @@ class Store:
                  ec_large_block: int = LARGE_BLOCK_SIZE,
                  ec_small_block: int = SMALL_BLOCK_SIZE,
                  compaction_bytes_per_second: int = 0,
-                 index_type: str = "auto"):
+                 index_type: str = "auto",
+                 partition: "tuple[int, int] | None" = None):
         # needle map kind for every owned volume (-index flag analog)
         self.index_type = index_type
+        # (index, total) under -workers N: this store owns only volumes
+        # with vid % total == index — workers sharing the data dirs open
+        # disjoint volume sets, so needle maps and file handles stay
+        # shared-nothing across processes (server/workers.py)
+        if partition is not None and not 0 <= partition[0] < partition[1]:
+            raise ValueError(f"bad store partition {partition}")
+        self.partition = partition
         self.dirs = dirs
         # vacuum copy rate limit applied to every owned volume
         # (compactionBytePerSecond flag)
@@ -64,6 +72,11 @@ class Store:
             os.makedirs(d, exist_ok=True)
             self._load_existing(d)
 
+    def owns(self, vid: int) -> bool:
+        """True when this store's partition covers the volume id."""
+        return self.partition is None or \
+            vid % self.partition[1] == self.partition[0]
+
     # ---- loading (disk_location.go:79-113, disk_location_ec.go:115-161) ----
 
     def _load_existing(self, d: str) -> None:
@@ -72,6 +85,8 @@ class Store:
             if not m:
                 continue
             vid = int(m.group("vid"))
+            if not self.owns(vid):
+                continue
             col = m.group("col") or ""
             try:
                 self.volumes[vid] = self._own(Volume(
@@ -85,7 +100,7 @@ class Store:
             if not m:
                 continue
             vid = int(m.group("vid"))
-            if vid in self.volumes:
+            if vid in self.volumes or not self.owns(vid):
                 continue
             col = m.group("col") or ""
             try:
@@ -100,7 +115,7 @@ class Store:
             if not m:
                 continue
             vid = int(m.group("vid"))
-            if vid in self.volumes:
+            if vid in self.volumes or not self.owns(vid):
                 continue
             col = m.group("col") or ""
             try:
@@ -135,6 +150,10 @@ class Store:
         with self._lock:
             if vid in self.volumes:
                 raise VolumeError(f"volume {vid} already exists")
+            if not self.owns(vid):
+                raise VolumeError(
+                    f"volume {vid} belongs to worker "
+                    f"{vid % self.partition[1]}, not {self.partition[0]}")
             v = self._own(Volume(
                 self.dirs[vid % len(self.dirs)], collection, vid,
                 replica_placement=ReplicaPlacement.parse(replication),
@@ -187,6 +206,9 @@ class Store:
         with self._lock:
             if vid in self.volumes:
                 return
+            if not self.owns(vid):
+                raise VolumeError(
+                    f"volume {vid} not in this worker's partition")
             for d in self.dirs:
                 base = os.path.join(
                     d, f"{collection}_{vid}" if collection else str(vid))
@@ -252,6 +274,9 @@ class Store:
 
     def mount_ec_shards(self, collection: str, vid: int) -> list[int]:
         with self._lock:
+            if not self.owns(vid):
+                raise VolumeError(
+                    f"ec volume {vid} not in this worker's partition")
             ev = self.ec_volumes.get(vid)
             if ev is not None:
                 ev.close()
@@ -359,9 +384,15 @@ class Store:
                     id=vid, collection=ev.collection, ec_index_bits=bits))
             max_key = max((v.nm.max_file_key
                            for v in self.volumes.values()), default=0)
+            # under -workers the slot budget is split across the worker
+            # fleet, or the master would see N× the real disk capacity
+            slots = sum(self.max_volume_counts)
+            if self.partition is not None:
+                idx, total = self.partition
+                slots = slots // total + (1 if idx < slots % total else 0)
             hb = pb.Heartbeat(
                 ip=self.ip, port=self.port, public_url=self.public_url,
-                max_volume_count=sum(self.max_volume_counts),
+                max_volume_count=slots,
                 max_file_key=max_key,
                 data_center=data_center, rack=rack,
                 volumes=volumes,

@@ -249,6 +249,33 @@ def test_vote_state_survives_restart(tmp_path):
     assert e3.term == 6 and e3.voted_for == "c:3"
 
 
+def test_stale_snapshot_still_persists_term_bump(tmp_path):
+    """ADVICE round 5: on_install_snapshot adopted a higher term but
+    only persisted when the snapshot was actually installed — a STALE
+    snapshot (last_index <= local) lost the bump on restart, so the
+    node could vote twice in that term after a crash."""
+    from seaweedfs_tpu.master.election import Election
+
+    path = str(tmp_path / "raft_state.json")
+    peers = ["a:1", "b:2", "c:3"]
+    e1 = Election("a:1", peers, state_path=path)
+    # local log already ahead of the snapshot's last_index
+    e1.on_append(term=3, leader="b:2", prev_index=0, prev_term=0,
+                 entries=[{"term": 3, "cmd": {"max_volume_id": 7}}],
+                 leader_commit=1)
+    assert e1.last_index() == 1
+    r = e1.on_install_snapshot(term=9, leader="c:3", last_index=0,
+                               last_term=0, value=0)
+    assert r["ok"] and e1.term == 9
+    # crash + restart: the term bump must have been durable
+    e2 = Election("a:1", peers, state_path=path)
+    assert e2.term == 9
+    r = e2.on_vote_request(term=9, candidate="b:2", max_volume_id=0,
+                           last_log_index=5, last_log_term=3)
+    # whatever the vote outcome, the term must not have regressed
+    assert e2.term == 9
+
+
 def test_corrupt_election_state_is_fatal(tmp_path):
     from seaweedfs_tpu.master.election import Election
 

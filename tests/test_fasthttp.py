@@ -23,8 +23,8 @@ async def _raw(host: str, port: int, payload: bytes,
     got = 0
     try:
         while got < expect_responses:
-            async with asyncio.timeout(timeout):
-                chunk = await r.read(65536)
+            # wait_for, not asyncio.timeout: 3.10 compatibility
+            chunk = await asyncio.wait_for(r.read(65536), timeout)
             if not chunk:
                 break
             out += chunk
@@ -108,14 +108,44 @@ def test_fast_path_wire_behaviors(tmp_path):
             assert b"Seaweed-Flavor: umami" in out
             assert b"paired" in out
 
-            # 5. whitelist 401 applies on the fast write path
+            # 5. whitelist 401 applies on the fast write path — and its
+            # declared Content-Length matches the body EXACTLY, so a
+            # keep-alive client can fire a pipelined request right after
+            # without blocking on a phantom byte (ADVICE round 5: the
+            # header said 33 for a 32-byte body)
             from seaweedfs_tpu.security.guard import Guard
             vs.guard = Guard(["10.9.9.9"])
             out = await _raw("127.0.0.1", vs.port,
-                             _req("POST", f"/{fid}", host, b"x"), 1)
-            assert b"401" in out.split(b"\r\n", 1)[0]
-            assert b"ip not in whitelist" in out
+                             _req("POST", f"/{fid}", host, b"x")
+                             + _req("POST", f"/{fid}", host, b"y"), 2)
+            assert out.count(b"401") >= 2
+            hdr, rest = out.split(b"\r\n\r\n", 1)
+            declared = int(hdr.lower().split(b"content-length: ")[1]
+                           .split(b"\r\n")[0])
+            body_1 = rest.split(b"HTTP/1.1", 1)[0]
+            assert len(body_1) == declared == \
+                len(b'{"error": "ip not in whitelist"}')
             vs.guard = Guard(())
+
+            # 5b. a handler that dies before answering must CLOSE the
+            # connection instead of wedging it busy forever (the
+            # create_task done-callback); later connections still work
+            real_count = vs.count
+            vs.count = lambda *a: (_ for _ in ()).throw(
+                RuntimeError("boom"))
+            try:
+                r2, w2 = await asyncio.open_connection(
+                    "127.0.0.1", vs.port)
+                w2.write(_req("GET", f"/{fid}", host))
+                await w2.drain()
+                eof = await asyncio.wait_for(r2.read(), 8)
+                assert eof == b""       # closed, not hung
+                w2.close()
+            finally:
+                vs.count = real_count
+            out = await _raw("127.0.0.1", vs.port,
+                             _req("GET", f"/{fid}", host), 1)
+            assert out.startswith(b"HTTP/1.1 200 ")
 
             # 6. 404 for a missing needle stays on the fast path
             missing = fid.split(",")[0] + ",ffffffffdeadbeef"

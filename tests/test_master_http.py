@@ -49,6 +49,49 @@ def test_submit_and_fid_redirect(tmp_path):
     run(body())
 
 
+def test_heartbeat_does_not_self_admit_whitelist(tmp_path):
+    """ADVICE round 5: an empty POST to /cluster/heartbeat used to
+    record req.remote into _peer_ips BEFORE any validation, letting any
+    client self-admit past -whiteList on /dir/lookup. Now only a
+    parseable volume-server registration admits the sender."""
+    import aiohttp
+
+    from seaweedfs_tpu.master.server import MasterServer
+
+    async def body():
+        m = MasterServer(port=0, white_list=["10.9.9.9"])
+        await m.start()
+        try:
+            async with aiohttp.ClientSession() as http:
+                murl = f"http://{m.url}"
+                # garbage heartbeats: rejected, nothing admitted
+                for payload in (b"", b"{}", b"[1,2]", b"{\"ip\": \"\"}"):
+                    async with http.post(f"{murl}/cluster/heartbeat",
+                                         data=payload) as resp:
+                        assert resp.status == 400, await resp.text()
+                assert not m._peer_ips
+                async with http.get(
+                        f"{murl}/dir/lookup",
+                        params={"volumeId": "1"}) as resp:
+                    assert resp.status == 401   # still whitelisted out
+                # a real registration DOES admit the volume server
+                from seaweedfs_tpu.pb import messages as pb
+                hb = pb.Heartbeat(ip="127.0.0.1", port=12345,
+                                  public_url="127.0.0.1:12345")
+                async with http.post(f"{murl}/cluster/heartbeat",
+                                     json=hb.to_dict()) as resp:
+                    assert resp.status == 200
+                assert "127.0.0.1" in m._peer_ips
+                async with http.get(
+                        f"{murl}/dir/lookup",
+                        params={"volumeId": "1"}) as resp:
+                    assert resp.status == 404   # past the guard now
+        finally:
+            await m.stop()
+
+    run(body())
+
+
 def test_http_vacuum_trigger(tmp_path):
     async def body():
         async with Cluster(str(tmp_path), n_servers=1) as c:

@@ -1,0 +1,480 @@
+"""-workers N process-per-core data plane (server/workers.py).
+
+Three layers of coverage:
+
+- In-proc units: store partitioning, WorkerContext state files,
+  prometheus merge, the master's seq-lease/assign-state endpoints and
+  an in-proc AssignAccelerator answering off them.
+- Wire-level subprocess cluster: a real `weed-tpu volume -workers 2`
+  fleet behind one SO_REUSEPORT port — owned vs sibling-proxied needle
+  GET/POST through the shared port, whole-host /metrics and /status
+  aggregation, and worker crash -> supervisor respawn -> service
+  resumes.
+- Satellite regressions ride along in test_fasthttp.py /
+  test_master_http.py / test_election.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from cluster_util import Cluster, run
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# in-proc units
+
+
+def test_store_partition_filters_ownership(tmp_path):
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.storage.volume import VolumeError
+    d = str(tmp_path)
+    full = Store([d], max_volume_counts=[8])
+    for vid in (1, 2, 3, 4):
+        full.add_volume(vid)
+    full.close()
+
+    s0 = Store([d], partition=(0, 2))
+    s1 = Store([d], partition=(1, 2))
+    try:
+        assert sorted(s0.volumes) == [2, 4]
+        assert sorted(s1.volumes) == [1, 3]
+        assert s0.owns(6) and not s0.owns(7)
+        with pytest.raises(VolumeError):
+            s0.add_volume(5)          # 5 % 2 == 1: not worker 0's
+        with pytest.raises(VolumeError):
+            s1.mount_volume("", 2)
+        # the slot budget is split so the master never sees N x capacity
+        hb0 = s0.collect_heartbeat()
+        hb1 = s1.collect_heartbeat()
+        assert hb0.max_volume_count + hb1.max_volume_count == 8
+    finally:
+        s0.close()
+        s1.close()
+
+
+def test_worker_context_state_files(tmp_path):
+    from seaweedfs_tpu.server.workers import WorkerContext
+    a = WorkerContext(0, 2, 8080, str(tmp_path), token="secret")
+    b = WorkerContext(1, 2, 8080, str(tmp_path), token="secret")
+    a.write_state(ip="127.0.0.1", port=4001, role="volume")
+    b.write_state(ip="127.0.0.1", port=4002, role="volume")
+    assert a.owns(2) and not a.owns(3)
+    assert b.sibling_addr(0) == "127.0.0.1:4001"
+    assert a.owner_addr(3) == "127.0.0.1:4002"
+    assert a.token_ok("secret") and not a.token_ok("wrong")
+    assert not a.token_ok(None)
+    states = a.all_states()
+    assert [s["port"] for s in states] == [4001, 4002]
+
+
+def test_merge_metrics_texts():
+    from seaweedfs_tpu.stats.metrics import merge_metrics_texts
+    t1 = (b"# HELP w writes\n# TYPE w counter\n"
+          b'w_total{op="write"} 3.0\nvols 2.0\nw_created 100.0\n')
+    t2 = (b"# HELP w writes\n# TYPE w counter\n"
+          b'w_total{op="write"} 4.0\nvols 5.0\nw_created 90.0\n')
+    merged = merge_metrics_texts([t1, t2]).decode()
+    assert 'w_total{op="write"} 7.0' in merged
+    assert "vols 7.0" in merged
+    assert "w_created 90.0" in merged          # min, not sum
+    assert merged.count("# HELP w writes") == 1
+
+
+def test_master_seq_lease_and_assign_state(tmp_path):
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            # grow one volume so the writable set is non-empty
+            a = await c.assign()
+            assert "fid" in a
+            async with c.http.get(
+                    f"http://{c.master.url}/cluster/seq_lease",
+                    params={"count": "512"}) as resp:
+                assert resp.status == 200
+                l1 = await resp.json()
+            async with c.http.get(
+                    f"http://{c.master.url}/cluster/seq_lease",
+                    params={"count": "512"}) as resp:
+                l2 = await resp.json()
+            assert l1["count"] == l2["count"] == 512
+            # non-overlapping blocks
+            assert l2["start"] >= l1["start"] + 512
+            async with c.http.get(
+                    f"http://{c.master.url}/cluster/assign_state",
+                    params={"collection": "", "replication": "000",
+                            "ttl": ""}) as resp:
+                assert resp.status == 200
+                st = await resp.json()
+            assert st["entries"], st
+            entry = st["entries"][0]
+            assert entry["url"] == c.servers[0].url
+    run(body())
+
+
+def test_assign_accelerator_in_proc(tmp_path):
+    """An AssignAccelerator wired to a live master answers /dir/assign
+    locally (unique keys from its lease, valid volume pick) and falls
+    back to None (=> proxy) for knobs it does not understand."""
+    from seaweedfs_tpu.server.workers import (AssignAccelerator,
+                                              WorkerContext)
+
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            a = await c.assign()          # ensure a writable volume
+            state_dir = str(tmp_path / "wstate")
+            primary = WorkerContext(0, 2, c.master.port, state_dir,
+                                    token="tok")
+            primary.write_state(ip="127.0.0.1", port=c.master.port,
+                                role="master")
+            ctx = WorkerContext(1, 2, c.master.port, state_dir,
+                                token="tok")
+            acc = AssignAccelerator("127.0.0.1", 0, ctx)
+            # port 0: skip the listener, drive fast_assign directly
+            import aiohttp
+            from seaweedfs_tpu.security import tls
+            acc._http = tls.make_session(
+                timeout=aiohttp.ClientTimeout(total=10))
+            try:
+                await acc._refill()
+                await acc._refresh("", "000", "")
+                assert acc._lease_end > acc._lease_next
+                outs = [acc.fast_assign(b"", "127.0.0.1")
+                        for _ in range(5)]
+                assert all(o is not None for o in outs)
+                fids = [json.loads(o.split(b"\r\n\r\n", 1)[1])["fid"]
+                        for o in outs]
+                keys = {f.split(",")[1][:-8] for f in fids}
+                assert len(keys) == 5                  # unique file keys
+                body0 = json.loads(outs[0].split(b"\r\n\r\n", 1)[1])
+                assert body0["url"] == c.servers[0].url
+                # a fast assign's needle is uploadable + readable
+                st, _ = await c.put(fids[0], body0["url"], b"acc-needle")
+                assert st == 201
+                st, got = await c.get(fids[0], body0["url"])
+                assert st == 200 and got == b"acc-needle"
+                # unknown knob -> None (the primary must decide)
+                assert acc.fast_assign(b"?dataCenter=dc9",
+                                       "127.0.0.1") is None
+                # count rides through and consumes count keys
+                o = acc.fast_assign(b"?count=7", "127.0.0.1")
+                assert json.loads(
+                    o.split(b"\r\n\r\n", 1)[1])["count"] == 7
+            finally:
+                await acc._http.close()
+    run(body())
+
+
+def test_worker_route_middleware_in_proc(tmp_path):
+    """Two in-proc volume servers partitioned 2 ways on one store dir:
+    a needle owned by worker 1 written/read THROUGH worker 0 is proxied
+    (fast path replays into aiohttp, middleware hops to the sibling)."""
+    from seaweedfs_tpu.server.volume_server import VolumeServer
+    from seaweedfs_tpu.server.workers import WorkerContext
+    from seaweedfs_tpu.storage.store import Store
+
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            state_dir = str(tmp_path / "wstate")
+            d = str(tmp_path / "wdata")
+            workers = []
+            for i in range(2):
+                ctx = WorkerContext(i, 2, 0, state_dir, token="tok")
+                store = Store([os.path.join(d)], max_volume_counts=[8],
+                              partition=(i, 2))
+                vs = VolumeServer(store, c.master.url, port=0,
+                                  pulse_seconds=0.2, worker_ctx=ctx)
+                await vs.start()
+                ctx.public_port = vs.port  # irrelevant for this test
+                await vs.heartbeat_once()
+                workers.append(vs)
+            try:
+                # volume 3 is owned by worker 1 (3 % 2)
+                workers[1].store.add_volume(3)
+                await workers[1].heartbeat_once()
+                fid = "3,0101deadbe"
+                # write through worker 0 -> proxied to worker 1
+                st, out = await c.put(fid, workers[0].url, b"hop")
+                assert st == 201, out
+                assert 3 in workers[1].store.volumes
+                n = workers[1].store.read_needle(3, 0x01)
+                assert n.data == b"hop"
+                # read back through worker 0 too
+                st, got = await c.get(fid, workers[0].url)
+                assert st == 200 and got == b"hop"
+                # and directly from the owner
+                st, got = await c.get(fid, workers[1].url)
+                assert st == 200 and got == b"hop"
+                # batch delete THROUGH the non-owner splits by owner
+                async with c.http.post(
+                        f"http://{workers[0].url}/admin/batch_delete",
+                        json={"fileIds": [fid]}) as resp:
+                    rows = (await resp.json())["results"]
+                assert rows[0]["status"] == 202, rows
+            finally:
+                for vs in workers:
+                    await vs.stop()
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# wire-level subprocess fleet
+
+
+def _get(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _post(url: str, data: bytes, timeout: float = 10.0) -> bytes:
+    req = urllib.request.Request(url, data=data, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def _wait(fn, tries: int = 50, delay: float = 0.3):
+    last = None
+    for _ in range(tries):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — startup polling
+            last = e
+            time.sleep(delay)
+    raise AssertionError(f"never became ready: {last}")
+
+
+class _Fleet:
+    """master + `volume -workers N` as real CLI subprocesses."""
+
+    def __init__(self, tmp: str, port0: int, workers: int = 2):
+        self.tmp = tmp
+        self.mport = port0
+        self.vport = port0 + 1
+        self.workers = workers
+        self.procs: list[subprocess.Popen] = []
+        self.env = dict(os.environ, JAX_PLATFORMS="cpu",
+                        PYTHONPATH=REPO)
+
+    def __enter__(self) -> "_Fleet":
+        def spawn(*args):
+            log = open(os.path.join(
+                self.tmp, f"proc{len(self.procs)}.log"), "w")
+            p = subprocess.Popen(
+                [sys.executable, "-m", "seaweedfs_tpu.cli", *args],
+                stdout=log, stderr=subprocess.STDOUT, env=self.env,
+                cwd=self.tmp)
+            self.procs.append(p)
+            return p
+
+        spawn("master", "-port", str(self.mport),
+              "-mdir", os.path.join(self.tmp, "m"),
+              "-volumeSizeLimitMB", "8", "-pulseSeconds", "1")
+        spawn("volume", "-port", str(self.vport),
+              "-dir", os.path.join(self.tmp, "v"), "-max", "20",
+              "-master", f"127.0.0.1:{self.mport}",
+              "-pulseSeconds", "1", "-workers", str(self.workers))
+        _wait(lambda: json.loads(_get(
+            f"http://127.0.0.1:{self.mport}/dir/assign"))["fid"])
+        # both workers registered (state files + live pids)
+        _wait(lambda: self.worker_rows() and all(
+            w["alive"] for w in self.worker_rows()))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in self.procs:
+            p.wait(timeout=10)
+        # SIGKILLing the supervisor orphans the workers; they watch
+        # their parent pid and exit on their own — wait for that
+        for w in self.worker_rows():
+            pid = w.get("pid")
+            if not pid:
+                continue
+            for _ in range(40):
+                try:
+                    os.kill(pid, 0)
+                except OSError:
+                    break
+                time.sleep(0.2)
+
+    def worker_rows(self) -> list[dict]:
+        try:
+            return json.loads(_get(
+                f"http://127.0.0.1:{self.vport}/stats/workers",
+                timeout=3))["workers"]
+        except Exception:  # noqa: BLE001
+            return []
+
+    def assign(self, **params) -> dict:
+        q = "&".join(f"{k}={v}" for k, v in params.items())
+        return json.loads(_get(
+            f"http://127.0.0.1:{self.mport}/dir/assign"
+            + (f"?{q}" if q else "")))
+
+
+def test_volume_workers_wire(tmp_path):
+    """The acceptance scenario: -workers 2 serves the shared port —
+    owned and sibling-owned needles both round-trip through it, stats
+    stay whole-host, and a killed worker is respawned and serves
+    again."""
+    with _Fleet(str(tmp_path), 22300) as f:
+        shared = f"http://127.0.0.1:{f.vport}"
+        # grow several volumes so BOTH partitions (vid % 2) own some
+        _get(f"http://127.0.0.1:{f.mport}/vol/grow?count=3")
+        payloads: dict[str, bytes] = {}
+        for i in range(24):
+            a = f.assign()
+            data = f"needle-{i}".encode() * (i % 5 + 1)
+            _post(f"http://{a['url']}/{a['fid']}", data)
+            payloads[a["fid"]] = data
+        vids = {int(fid.split(",")[0]) for fid in payloads}
+        rows = _wait(lambda: [r for r in f.worker_rows()
+                              if r.get("volumes")] and f.worker_rows())
+        # every needle reads back through the SHARED port, whichever
+        # worker accepts the connection (sibling proxy covers the rest)
+        for fid, want in payloads.items():
+            assert _get(f"{shared}/{fid}") == want
+        # raw keep-alive pipelining through the shared port: one
+        # connection (= one worker), POST + 2 GETs, with the needle
+        # owned by EITHER partition — responses must stay in sequence
+        # whether served locally or via the sibling proxy
+        async def pipelined(fid: str, data: bytes) -> bytes:
+            r, w = await asyncio.open_connection("127.0.0.1", f.vport)
+            host = f"127.0.0.1:{f.vport}"
+            blob = (
+                f"POST /{fid} HTTP/1.1\r\nHost: {host}\r\n"
+                f"Content-Length: {len(data)}\r\n\r\n".encode() + data
+                + f"GET /{fid} HTTP/1.1\r\nHost: {host}\r\n\r\n".encode()
+                + f"GET /{fid} HTTP/1.1\r\nHost: {host}\r\n\r\n".encode())
+            w.write(blob)
+            await w.drain()
+            out = b""
+            # proxied responses stream headers and body in separate
+            # writes: read until both GET bodies fully arrived, not
+            # just until the third status line shows up
+            while out.count(b"HTTP/1.1 ") < 3 or out.count(data) < 2:
+                try:
+                    chunk = await asyncio.wait_for(r.read(65536), 10)
+                except asyncio.TimeoutError:
+                    break
+                if not chunk:
+                    break
+                out += chunk
+            w.close()
+            return out
+
+        for parity in (0, 1):
+            a = _wait(lambda p=parity: [x for x in (f.assign(),)
+                      if int(x["fid"].split(",")[0]) % 2 == p][0],
+                      tries=60, delay=0.1)
+            data = f"pipelined-{parity}".encode()
+            out = asyncio.run(pipelined(a["fid"], data))
+            assert out.count(b"HTTP/1.1 201 ") == 1, out[:200]
+            assert out.count(b"HTTP/1.1 200 ") == 2
+            assert out.count(data) == 2
+            payloads[a["fid"]] = data
+
+        # whole-host status: all vids visible via one worker
+        st = json.loads(_get(f"{shared}/status"))
+        assert st.get("workers") == 2
+        assert {m["id"] for m in st["volumes"]} >= vids
+        # aggregated metrics count every write, not one worker's share
+        metrics = _get(f"{shared}/metrics").decode()
+        wrote = [ln for ln in metrics.splitlines()
+                 if ln.startswith("SeaweedFS_volumeServer_request_total")
+                 and 'type="write"' in ln and 'status="ok"' in ln]
+        assert wrote and float(wrote[0].rsplit(" ", 1)[1]) >= \
+            len(payloads)
+
+        # ---- crash -> respawn -> serve again ----
+        victim = [r for r in f.worker_rows() if r["index"] == 1][0]
+        os.kill(victim["pid"], signal.SIGKILL)
+        _wait(lambda: [r for r in f.worker_rows()
+                       if r["index"] == 1 and r["alive"]
+                       and r["pid"] != victim["pid"]][0], tries=80)
+        # a needle owned by the killed worker serves again (retry
+        # through the respawn window)
+        odd = [fid for fid in payloads
+               if int(fid.split(",")[0]) % 2 == 1]
+        for fid in odd or list(payloads):
+            _wait(lambda: _get(f"{shared}/{fid}") == payloads[fid]
+                  or (_ for _ in ()).throw(AssertionError("stale")),
+                  tries=40)
+
+
+def test_master_workers_wire(tmp_path):
+    """`master -workers 2`: assigns through the shared port stay unique
+    (accelerator lease blocks), cold master routes answer via the
+    transparent proxy, and heartbeats landing on the accelerator still
+    register with the primary."""
+    tmp = str(tmp_path)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = []
+
+    def spawn(*args):
+        log = open(os.path.join(tmp, f"proc{len(procs)}.log"), "w")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", *args],
+            stdout=log, stderr=subprocess.STDOUT, env=env, cwd=tmp)
+        procs.append(p)
+        return p
+
+    mport, vport = 22320, 22321
+    try:
+        spawn("master", "-port", str(mport),
+              "-mdir", os.path.join(tmp, "m"), "-pulseSeconds", "1",
+              "-workers", "2")
+        time.sleep(2)
+        spawn("volume", "-port", str(vport),
+              "-dir", os.path.join(tmp, "v"), "-max", "20",
+              "-master", f"127.0.0.1:{mport}", "-pulseSeconds", "1")
+        _wait(lambda: json.loads(_get(
+            f"http://127.0.0.1:{mport}/dir/assign"))["fid"])
+        keys = set()
+        payload = None
+        for i in range(30):
+            a = json.loads(_get(
+                f"http://127.0.0.1:{mport}/dir/assign"))
+            key = a["fid"].split(",")[1][:-8]
+            assert key not in keys, f"duplicate file key {a['fid']}"
+            keys.add(key)
+            if payload is None:
+                payload = (a["fid"], b"via-master-workers")
+                _post(f"http://{a['url']}/{a['fid']}", payload[1])
+        assert _get(f"http://127.0.0.1:{vport}/{payload[0]}") \
+            == payload[1]
+        # cold routes through the shared port (proxy on the accelerator)
+        for _ in range(6):
+            st = json.loads(_get(
+                f"http://127.0.0.1:{mport}/dir/status"))
+            assert "topology" in st
+            cs = json.loads(_get(
+                f"http://127.0.0.1:{mport}/cluster/status"))
+            assert cs["leader"] == f"127.0.0.1:{mport}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=10)
+        # collect orphaned workers (parent-watch exit)
+        time.sleep(0)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if not [1 for line in os.popen(
+                    "ps -eo pid,args").read().splitlines()
+                    if "-workerIndex" in line and f"{mport}" in line]:
+                break
+            time.sleep(0.3)

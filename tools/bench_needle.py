@@ -1,0 +1,105 @@
+"""Needle data-plane benchmark driver, multi-worker aware.
+
+Automates the BENCH_NEEDLE.md measurement: starts a master + volume
+server as real CLI processes, runs `weed-tpu benchmark` against them
+over real sockets, and repeats for each requested `-workers` value so
+single-core regressions and multi-core scaling are one command:
+
+    python tools/bench_needle.py                 # workers 1 and 2
+    python tools/bench_needle.py 1 2 4           # explicit sweep
+    SWTPU_BENCH_N=20000 python tools/bench_needle.py 1 4
+
+Prints one JSON line per configuration:
+    {"workers": 1, "write_rps": ..., "read_rps": ...}
+
+Scaling expectation (PERF.md): each worker runs the full single-core
+fast path independently behind SO_REUSEPORT, so throughput scales with
+PHYSICAL cores; on a one-core host extra workers only add scheduling
+overhead (~10% measured round 6) — run the sweep on the target host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE_PORT = 21700
+
+_RPS = re.compile(r"^(write|read):\s+([0-9.]+) req/s", re.M)
+
+
+def _wait_assign(master: str, tries: int = 60) -> None:
+    for _ in range(tries):
+        try:
+            with urllib.request.urlopen(
+                    f"http://{master}/dir/assign", timeout=3) as r:
+                if b"fid" in r.read():
+                    return
+        except OSError:
+            pass
+        time.sleep(0.5)
+    raise RuntimeError("cluster never became assignable")
+
+
+def bench_one(workers: int, n: int, size: int, conc: int) -> dict:
+    tmp = tempfile.mkdtemp(prefix=f"swtpu_bn_w{workers}_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs: list[subprocess.Popen] = []
+    master = f"127.0.0.1:{BASE_PORT}"
+
+    def spawn(*args: str) -> None:
+        log = open(os.path.join(tmp, f"proc{len(procs)}.log"), "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", *args],
+            stdout=log, stderr=subprocess.STDOUT, env=env, cwd=tmp))
+
+    try:
+        spawn("master", "-port", str(BASE_PORT),
+              "-mdir", os.path.join(tmp, "m"), "-pulseSeconds", "2")
+        time.sleep(2)
+        vol = ["volume", "-port", str(BASE_PORT + 1),
+               "-dir", os.path.join(tmp, "v"), "-max", "50",
+               "-master", master, "-pulseSeconds", "2"]
+        if workers > 1:
+            vol += ["-workers", str(workers)]
+        spawn(*vol)
+        _wait_assign(master)
+        out = subprocess.run(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", "benchmark",
+             "-master", master, "-n", str(n), "-size", str(size),
+             "-c", str(conc)],
+            capture_output=True, text=True, env=env, cwd=tmp,
+            timeout=1800).stdout
+        rates = dict(_RPS.findall(out))
+        return {"workers": workers,
+                "write_rps": float(rates.get("write", 0.0)),
+                "read_rps": float(rates.get("read", 0.0)),
+                "n": n, "size": size, "concurrency": conc}
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            p.wait(timeout=10)
+        time.sleep(1)   # workers notice the dead supervisor and exit
+
+
+def main() -> None:
+    sweep = [int(a) for a in sys.argv[1:]] or [1, 2]
+    n = int(os.environ.get("SWTPU_BENCH_N", "10000"))
+    size = int(os.environ.get("SWTPU_BENCH_SIZE", "1024"))
+    conc = int(os.environ.get("SWTPU_BENCH_C", "64"))
+    for w in sweep:
+        print(json.dumps(bench_one(w, n, size, conc)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,8 @@ over real sockets, and byte-verifies every surviving file at the end.
     python tools/soak.py rebuild       # encode, SIGKILL a shard holder, rebuild
     python tools/soak.py failover      # SIGKILL the leader master under load
     python tools/soak.py partition     # cut the leader's raft links (alive)
+    python tools/soak.py workers       # -workers 2 fleet: writes under worker
+                                       # SIGKILLs, byte-verify via shared port
     python tools/soak.py all
 
 Exit code 0 only when every read verifies.
@@ -531,12 +533,117 @@ async def scenario_partition(tmp: str) -> int:
         procs.kill_all()
 
 
+async def scenario_workers(tmp: str) -> int:
+    """-workers 2 volume fleet (SO_REUSEPORT, vid % 2 partitioning):
+    continuous writes while each worker is SIGKILLed in turn (the
+    supervisor respawns them), then every surviving byte is verified
+    through the SHARED port, exercising the sibling proxy path for the
+    ~half of reads the kernel routes to the non-owner."""
+    import json
+    import urllib.request as urq
+
+    from seaweedfs_tpu.util.client import WeedClient
+    procs = Procs(tmp)
+    try:
+        port0 = BASE_PORT + 60
+        master = f"127.0.0.1:{port0}"
+        procs.spawn("master", "-port", str(port0),
+                    "-mdir", os.path.join(procs.tmp, "m"),
+                    "-volumeSizeLimitMB", "8", "-pulseSeconds", "1")
+        time.sleep(2)
+        vport = port0 + 1
+        procs.spawn("volume", "-port", str(vport),
+                    "-dir", os.path.join(procs.tmp, "v0"),
+                    "-max", "20", "-master", master,
+                    "-pulseSeconds", "1", "-workers", "2")
+        wait_assign(master)
+
+        def worker_rows():
+            with urq.urlopen(f"http://127.0.0.1:{vport}/stats/workers",
+                             timeout=3) as r:
+                return json.load(r)["workers"]
+
+        rng = random.Random(77)
+        payloads: dict = {}
+        errors: list = []
+        stop = asyncio.Event()
+        async with WeedClient(master) as c:
+            async def writer():
+                while not stop.is_set():
+                    data = rng.randbytes(rng.randint(500, 20000))
+                    try:
+                        fid = await c.upload_data(data)
+                        payloads[fid] = data
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(str(e)[:60])
+                        await asyncio.sleep(0.1)
+
+            writers = [asyncio.create_task(writer()) for _ in range(6)]
+            await asyncio.sleep(4)
+            bad = 0
+            for victim_idx in (1, 0):
+                rows = await asyncio.to_thread(worker_rows)
+                victim = [w for w in rows
+                          if w["index"] == victim_idx][0]
+                os.kill(victim["pid"], signal.SIGKILL)
+                print(f"  killed worker {victim_idx} "
+                      f"(pid {victim['pid']}, {len(payloads)} files)")
+                t0 = time.time()
+                while time.time() - t0 < 30:
+                    await asyncio.sleep(0.5)
+                    rows = await asyncio.to_thread(worker_rows)
+                    me = [w for w in rows if w["index"] == victim_idx]
+                    if me and me[0]["alive"] \
+                            and me[0]["pid"] != victim["pid"]:
+                        break
+                else:
+                    print(f"  FAIL: worker {victim_idx} not respawned "
+                          f"within 30s")
+                    bad += 1
+                await asyncio.sleep(3)
+            stop.set()
+            await asyncio.gather(*writers, return_exceptions=True)
+            print(f"  {len(payloads)} files written "
+                  f"({len(errors)} transient errors)")
+
+            # byte-verify through the SHARED port only: whichever
+            # worker accepts each connection must serve or proxy
+            async def shared_read(fid: str) -> bytes:
+                path = f"http://127.0.0.1:{vport}/{fid}"
+                return await asyncio.to_thread(
+                    lambda: urq.urlopen(path, timeout=10).read())
+
+            sem = asyncio.Semaphore(16)
+            failures = []
+
+            async def check(fid, want):
+                async with sem:
+                    try:
+                        got = await shared_read(fid)
+                    except Exception as e:  # noqa: BLE001
+                        failures.append((fid, str(e)[:60]))
+                        return
+                if got != want:
+                    failures.append((fid, "MISMATCH"))
+
+            await asyncio.gather(*(check(f, w)
+                                   for f, w in payloads.items()))
+            print(f"  shared-port verify: bad={len(failures)}"
+                  f"/{len(payloads)}")
+            for fid, why in failures[:5]:
+                print("   ", fid, why)
+            return bad + len(failures)
+    finally:
+        procs.kill_all()
+
+
 SCENARIOS = {
     "ec": scenario_ec,
     "vacuum-race": scenario_vacuum_race,
     "rebuild": scenario_rebuild,
     "failover": scenario_failover,
     "partition": scenario_partition,
+    "workers": scenario_workers,
 }
 
 
