@@ -1466,6 +1466,13 @@ def main(argv: list[str] | None = None) -> None:
         if args.cpuprofile or args.memprofile:
             from .util.pprof import setup_profiling
             setup_profiling(args.cpuprofile, args.memprofile)
+        if os.environ.get("WEED_FAILPOINTS"):
+            # armed at import by util/failpoints; an injected-fault run
+            # must never be mistakable for a healthy one in the logs
+            from .util import failpoints
+            glog.warning("FAILPOINTS ARMED: %s",
+                         ", ".join(f"{a['site']}={a['action']}"
+                                   for a in failpoints.list_armed()))
     _discover_security_toml()
     if args.cmd == "version":
         from . import __version__

@@ -17,8 +17,12 @@ async def stream_chunk_views(client, chunks: list[FileChunk], offset: int,
                              length: int):
     """Async-generate data blocks for [offset, offset+length).
 
-    `client.read(fid, offset, size)` failures propagate to the caller
-    (typically translated into a transport abort once headers are sent).
+    Each view streams through `client.read_stream`, which carries the
+    degraded-read failover: a replica dying mid-chunk rotates to the
+    next location and resumes via Range, so the filer response keeps
+    flowing instead of aborting. Only a full miss (every holder down)
+    propagates to the caller (typically translated into a transport
+    abort once headers are sent).
     """
     pos = offset
     stop = offset + length
@@ -27,9 +31,10 @@ async def stream_chunk_views(client, chunks: list[FileChunk], offset: int,
             n = min(_ZERO_BLOCK, view.logic_offset - pos)
             yield b"\x00" * n
             pos += n
-        data = await client.read(view.file_id, view.offset, view.size)
-        yield data
-        pos += len(data)
+        async for data in client.read_stream(view.file_id, view.offset,
+                                             view.size):
+            yield data
+            pos += len(data)
     while pos < stop:  # tail hole / short chunk
         n = min(_ZERO_BLOCK, stop - pos)
         yield b"\x00" * n

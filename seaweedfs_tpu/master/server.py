@@ -24,7 +24,7 @@ from ..topology.layout import (LayoutKey, PlacementError, VolumeLayout,
                                find_empty_slots)
 from ..topology.tree import DataNode, Topology
 from ..security import tls
-from ..util import glog
+from ..util import failpoints, glog
 from .election import Election
 from .sequence import MemorySequencer
 
@@ -173,6 +173,8 @@ class MasterServer:
         app.router.add_get("/cluster/assign_state", self.h_assign_state)
         app.router.add_get("/stats/health", self.h_health)
         app.router.add_get("/metrics", self.h_metrics)
+        app.router.add_route("*", "/debug/failpoints",
+                             failpoints.handle_debug)
         app.router.add_route("*", "/vol/grow", self.h_grow)
         app.router.add_route("*", "/vol/vacuum", self.h_vacuum)
         app.router.add_route("*", "/col/delete", self.h_collection_delete)
@@ -467,6 +469,12 @@ class MasterServer:
     async def h_assign(self, req: web.Request) -> web.Response:
         if not self.is_leader:
             return await self._proxy_to_leader(req)
+        try:
+            # chaos site: injected assign faults (error => client retry
+            # with backoff; latency => client deadline discipline)
+            await failpoints.fail("master.assign")
+        except OSError as e:
+            return web.json_response({"error": str(e)}, status=503)
         q = req.query
         count = int(q.get("count", 1) or 1)
         collection = q.get("collection", "")

@@ -99,7 +99,14 @@ class FilerSink(ReplicationSink):
 
     async def _replicate_chunks(
             self, chunks: list[FileChunk]) -> list[FileChunk]:
+        from ..util import failpoints
+
         async def one(c: FileChunk) -> FileChunk:
+            # chaos site: a flaky cross-cluster hop (FailpointError is
+            # an OSError) surfaces to the runner, which retries the
+            # whole entry — upload_data's own retry policy absorbs the
+            # transient ones below it
+            await failpoints.fail("replication.sink")
             data = await self.source.read_part(c.file_id)
             fid = await self._client.upload_data(
                 data, collection=self.collection,

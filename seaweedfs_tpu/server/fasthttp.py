@@ -35,6 +35,8 @@ from ..storage.needle import (FLAG_HAS_LAST_MODIFIED, CrcMismatch, Needle,
                               NeedleError)
 from ..storage.volume import AlreadyDeleted, NotFound, VolumeError
 from ..ec.ec_volume import EcVolumeError
+from ..util.failpoints import (FailpointDrop, FailpointError,
+                               pending as _fp_pending)
 
 _REQ_LINE = re.compile(
     rb"^(GET|POST|PUT) /(\d+,[0-9a-fA-F]+)((?:\?[^ ]*)?) HTTP/1\.1$")
@@ -273,6 +275,15 @@ class FastNeedleProtocol(asyncio.Protocol):
             vs.count("read", "error")
             self._finish(_json_err(503, "Service Unavailable", str(e)))
             return
+        except FailpointDrop:
+            # injected connection drop: sever, don't answer
+            self._closed = True
+            self._busy = False
+            self.transport.close()
+            return
+        except FailpointError as e:
+            self._finish(_json_err(e.status, "Injected Error", str(e)))
+            return
         except Exception as e:  # noqa: BLE001 — keep the conn coherent
             self._finish(_json_err(500, "Internal Server Error", str(e)))
             return
@@ -369,6 +380,14 @@ class FastNeedleProtocol(asyncio.Protocol):
             return
         except VolumeError as e:
             self._finish(_json_err(409, "Conflict", str(e)))
+            return
+        except FailpointDrop:
+            self._closed = True
+            self._busy = False
+            self.transport.close()
+            return
+        except FailpointError as e:
+            self._finish(_json_err(e.status, "Injected Error", str(e)))
             return
         except Exception as e:  # noqa: BLE001
             self._finish(_json_err(500, "Internal Server Error", str(e)))
@@ -479,6 +498,8 @@ class FastAssignProtocol(asyncio.Protocol):
     def _assign(self, q: bytes) -> bytes | None:
         """Synchronous assign; None => let aiohttp handle it."""
         ms = self.ms
+        if _fp_pending("master.assign"):
+            return None             # armed failpoint: full handler fires it
         if not ms.is_leader:
             return None             # leader proxy path
         count_s = collection = replication = ttl = b""

@@ -77,6 +77,9 @@ class FilerServer:
         ]
         for method, path, handler in api:
             app.router.add_route(method, path, handler)
+        from ..util import failpoints
+        app.router.add_route("*", "/__debug__/failpoints",
+                             failpoints.handle_debug)
         app.router.add_route("GET", "/{path:.*}", self.h_get)
         app.router.add_route("HEAD", "/{path:.*}", self.h_get)
         app.router.add_route("POST", "/{path:.*}", self.h_post)
@@ -125,13 +128,20 @@ class FilerServer:
         self._pending.extend(fids)
 
     async def _chunk_gc_loop(self) -> None:
+        from ..util import glog
+        from ..util.client import OperationError
         while True:
             await asyncio.sleep(1.0)
             batch, self._pending = self._pending[:1024], self._pending[1024:]
             if batch:
                 try:
                     await self.client.delete_fids(batch)
-                except Exception:
+                except (OperationError, aiohttp.ClientError,
+                        asyncio.TimeoutError, OSError) as e:
+                    # transient tier outage: requeue, but visibly — a
+                    # permanently failing GC loop leaks chunks forever
+                    glog.warning("filer chunk gc: %d fids requeued: %s",
+                                 len(batch), e)
                     self._pending.extend(batch)
 
     # ---- normalize ----

@@ -14,6 +14,7 @@ import asyncio
 import gzip
 import json
 import os
+import random
 import re
 import time
 
@@ -24,7 +25,8 @@ from ..ec import gf
 from ..ec import pipeline as ecpl
 from ..ec.ec_volume import EcVolumeError
 from ..pb import messages as pb
-from ..util import glog
+from ..util import failpoints, glog
+from ..util.resilience import BreakerRegistry
 from ..storage import types as t
 from ..storage.needle import (FLAG_GZIP, FLAG_HAS_LAST_MODIFIED,
                               FLAG_IS_CHUNK_MANIFEST, CrcMismatch, Needle,
@@ -116,6 +118,10 @@ class VolumeServer:
         self._tasks: list[asyncio.Task] = []
         self._http: aiohttp.ClientSession | None = None
         self._hb_lock = asyncio.Lock()
+        # per-sibling breakers: a crashed worker is answered 503 in
+        # microseconds instead of a connect timeout per proxied request
+        self._sibling_breakers = BreakerRegistry(
+            threshold=3, reset_timeout=2.0)
         from .ec_locations import EcLocationCache
         self._ec_locations = EcLocationCache(self._lookup_ec_locations)
         self.app = self._build_app()
@@ -168,7 +174,17 @@ class VolumeServer:
             return web.json_response(
                 {"error": f"worker {wc.owner_index(vid)} (owner of "
                           f"volume {vid}) unavailable"}, status=503)
-        return await wk.proxy_request(req, self._http, target, wc.token)
+        br = self._sibling_breakers.get(target)
+        if not br.allow():
+            return web.json_response(
+                {"error": f"worker {wc.owner_index(vid)} (owner of "
+                          f"volume {vid}) circuit open"}, status=503)
+        resp = await wk.proxy_request(req, self._http, target, wc.token)
+        if resp.status == 502:
+            br.record_failure()
+        else:
+            br.record_success()
+        return resp
 
     def _build_app(self) -> web.Application:
         from ..security.guard import middleware as guard_mw
@@ -210,6 +226,8 @@ class VolumeServer:
         app.router.add_post("/admin/query", self.h_query)
         app.router.add_post("/admin/tier/upload", self.h_tier_upload)
         app.router.add_post("/admin/tier/download", self.h_tier_download)
+        app.router.add_route("*", "/debug/failpoints", self.h_failpoints)
+        app.router.add_get("/debug/breakers", self.h_breakers)
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_get("/stats/workers", self.h_stats_workers)
@@ -324,6 +342,7 @@ class VolumeServer:
         staleness-tiered cache (store_ec.go:218-259) so a degraded-read
         burst costs one master lookup, not one per interval."""
         import urllib.request
+        from http.client import HTTPException
         shards = self._ec_locations.get(vid)
         if shards is None:
             return None
@@ -343,7 +362,16 @@ class VolumeServer:
                     data = r.read()
                     if len(data) == size:
                         return data
-            except Exception:
+                    glog.warning("remote ec shard %d.%d from %s: short "
+                                 "read %d/%d", vid, shard_id, target,
+                                 len(data), size)
+            except (OSError, ValueError, HTTPException) as e:
+                # OSError covers urllib's URLError/HTTPError and socket
+                # timeouts; HTTPException covers a holder dying
+                # mid-body (IncompleteRead, RemoteDisconnected). A
+                # swallowed holder failure must be visible.
+                glog.warning("remote ec shard %d.%d from %s: %s",
+                             vid, shard_id, target, e)
                 continue
         if attempted:
             # a listed holder failed to serve: the map moved under us,
@@ -376,9 +404,20 @@ class VolumeServer:
                 metrics.VOLUME_COUNT.set(len(self.store.volumes))
             hb = self.store.collect_heartbeat(self.data_center, self.rack)
             try:
+                # injected heartbeat faults (FailpointError is an
+                # OSError) take the exact requeue-and-rotate path a
+                # real dead master does
+                await failpoints.fail("volume.heartbeat")
+                # per-request timeout: a master that accepts the TCP
+                # connect but never answers must not wedge the pulse
+                # loop for the session default
                 async with self._http.post(
                         tls.url(self.master_url, "/cluster/heartbeat"),
-                        json=hb.to_dict()) as resp:
+                        json=hb.to_dict(),
+                        timeout=aiohttp.ClientTimeout(
+                            total=max(10.0, 4 * self.pulse_seconds),
+                            connect=5, sock_read=max(
+                                5.0, 2 * self.pulse_seconds))) as resp:
                     body = await resp.json()
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
                 self._requeue_deltas(hb)
@@ -408,14 +447,20 @@ class VolumeServer:
         while True:
             try:
                 await self.heartbeat_once()
-            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
                 # current master unreachable: rotate through seed masters
                 # (with one seed this still resets master_url back to the
                 # configured seed after a learned leader dies)
+                glog.V(1).infof("volume %s: heartbeat to %s failed (%s); "
+                                "rotating seed", self.url,
+                                self.master_url, e)
                 self._seed_idx = (self._seed_idx + 1) \
                     % len(self.master_seeds)
                 self.master_url = self.master_seeds[self._seed_idx]
-            await asyncio.sleep(self.pulse_seconds)
+            # ±20% jitter: a restarted master must not be hit by the
+            # whole fleet's pulses in one synchronized herd
+            await asyncio.sleep(
+                self.pulse_seconds * random.uniform(0.8, 1.2))
 
     # ---- public needle handlers ----
 
@@ -461,6 +506,13 @@ class VolumeServer:
             if metrics.HAVE_PROMETHEUS:
                 metrics.VOLUME_REQUEST_COUNTER.labels("read", "404").inc()
             return web.Response(status=404)
+        except failpoints.FailpointDrop:
+            # injected connection drop: sever, don't answer
+            if req.transport is not None:
+                req.transport.close()
+            return web.Response(status=500)
+        except failpoints.FailpointError as e:
+            return web.json_response({"error": str(e)}, status=e.status)
         except CrcMismatch as e:
             return web.json_response({"error": str(e)}, status=500)
         except (EcVolumeError, BackendError) as e:
@@ -566,6 +618,14 @@ class VolumeServer:
         if req.method == "HEAD":
             return web.Response(status=status, headers=headers,
                                 content_type=ct)
+        # chaos site: error / latency / drop / truncate (the latter
+        # declares the full Content-Length, streams a prefix and severs
+        # the socket — the mid-read death degraded reads must survive)
+        fp = await failpoints.http_respond(
+            req, "volume.read.http", body=body, headers=headers,
+            content_type=ct, status=status)
+        if fp is not None:
+            return fp
         return web.Response(body=body, headers=headers, content_type=ct,
                             status=status)
 
@@ -728,6 +788,12 @@ class VolumeServer:
         except NotFound:
             return web.json_response({"error": "volume not found"},
                                      status=404)
+        except failpoints.FailpointDrop:
+            if req.transport is not None:
+                req.transport.close()
+            return web.Response(status=500)
+        except failpoints.FailpointError as e:
+            return web.json_response({"error": str(e)}, status=e.status)
         except NeedleError as e:
             # e.g. >64KB of Seaweed-* pair headers: a client error, not
             # an unhandled 500 (needle.py:122 pairs-size limit)
@@ -774,10 +840,14 @@ class VolumeServer:
                     cm = ChunkManifest.load(existing.data,
                                             existing.is_gzipped)
                     await cm.delete_chunks(self._weed_client())
-            except (NotFound, AlreadyDeleted, ValueError, KeyError,
-                    BackendError):
-                # tier outage: skip the manifest check, still tombstone
-                pass
+            except (NotFound, AlreadyDeleted):
+                pass  # nothing stored: plain tombstone below
+            except (ValueError, KeyError, BackendError) as e:
+                # tier outage / corrupt manifest: still tombstone, but
+                # the skipped cascade must be visible — its chunks may
+                # now be orphaned
+                glog.warning("delete %s: manifest cascade skipped: %s",
+                             req.match_info["fid"], e)
         try:
             size = await loop.run_in_executor(
                 None, lambda: self.store.delete_needle(fid.volume_id, n))
@@ -931,8 +1001,11 @@ class VolumeServer:
                         params={"type": "replicate"},
                         headers=headers) as r:
                     await r.read()
-            except aiohttp.ClientError:
-                pass
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                # best-effort broadcast, but a holder that kept its
+                # shard tombstone-free must be visible in the logs
+                glog.warning("ec delete %s: broadcast to %s failed: %s",
+                             fid, target, e)
 
         await asyncio.gather(*(one(u) for u in targets))
 
@@ -957,19 +1030,34 @@ class VolumeServer:
 
         async def one(target: str) -> bool:
             try:
+                # chaos sites: `volume.replicate` injects transport
+                # faults on the fan-out hop; `volume.replicate.body`
+                # truncates the serialized needle so the replica's CRC
+                # check rejects the torn write (the acknowledged copy
+                # is then the only durable one — exactly the shape the
+                # degraded-read soak must survive)
+                await failpoints.fail("volume.replicate")
                 if method == "POST":
+                    body = failpoints.corrupt("volume.replicate.body",
+                                              raw_needle)
                     async with self._http.post(
                             tls.url(target, f"/{fid}"),
                             params={"type": "replicate"},
-                            data=raw_needle,
+                            data=body,
                             headers={"X-Raw-Needle": "1", **extra}) as r:
-                        return r.status in (200, 201)
+                        ok = r.status in (200, 201)
+                        if not ok:
+                            glog.warning(
+                                "replicate %s to %s: http %d", fid,
+                                target, r.status)
+                        return ok
                 async with self._http.delete(
                         tls.url(target, f"/{fid}"),
                         params={"type": "replicate"},
                         headers=extra) as r:
                     return r.status == 200
-            except aiohttp.ClientError:
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                glog.warning("replicate %s to %s: %s", fid, target, e)
                 return False
 
         results = await asyncio.gather(*(one(x) for x in targets))
@@ -1000,8 +1088,12 @@ class VolumeServer:
                         timeout=aiohttp.ClientTimeout(total=3)) as r:
                     if r.status == 200:
                         out.append((i, await r.read()))
-            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
-                pass
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                # aggregation proceeds without the sibling, but the gap
+                # must be visible: an operator reading summed /metrics
+                # would otherwise see a silently smaller fleet
+                glog.V(1).infof("sibling %d %s unreachable: %s",
+                                i, path, e)
 
         await asyncio.gather(*(one(i) for i in range(wc.total)
                                if i != wc.index))
@@ -1019,6 +1111,48 @@ class VolumeServer:
         texts += [body for _, body in await self._sibling_get("/metrics")]
         return web.Response(body=merge_metrics_texts(texts),
                             content_type="text/plain")
+
+    async def h_failpoints(self, req: web.Request) -> web.Response:
+        """/debug/failpoints with -workers fan-out: failpoint state is
+        per-process, and the public port is SO_REUSEPORT-balanced, so
+        an arm/disarm that landed on one worker must propagate to every
+        sibling or the fleet would inject faults on ~1/N of requests
+        (and a follow-up GET would report nothing armed). Query-param
+        arming only — a consumed JSON body is not replayed."""
+        resp = await failpoints.handle_debug(req)
+        wc = self.worker_ctx
+        if wc is None or self._is_worker_hop(req) \
+                or req.method == "GET" or resp.status != 200:
+            return resp
+
+        async def one(i: int) -> None:
+            addr = wc.sibling_addr(i)
+            if addr is None:
+                return
+            try:
+                async with self._http.request(
+                        req.method, tls.url(addr, "/debug/failpoints"),
+                        params=req.query,
+                        headers={_wk().WORKER_HEADER: wc.token},
+                        timeout=aiohttp.ClientTimeout(total=5)) as r:
+                    await r.read()
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError) as e:
+                glog.warning("failpoint fan-out to worker %d: %s", i, e)
+
+        await asyncio.gather(*(one(i) for i in range(wc.total)
+                               if i != wc.index))
+        return resp
+
+    async def h_breakers(self, req: web.Request) -> web.Response:
+        """Circuit-breaker states of this server's upstream hops
+        (sibling workers + the lazily-built weed client), for chaos
+        runs and operators probing a brown-out."""
+        out = {"siblings": self._sibling_breakers.to_dict()}
+        wc = getattr(self, "_wclient", None)
+        if wc is not None:
+            out["client"] = wc.breakers.to_dict()
+        return web.json_response(out)
 
     async def h_status(self, req: web.Request) -> web.Response:
         vols = [self.store._volume_message(v).to_dict()
@@ -1058,8 +1192,13 @@ class VolumeServer:
                 try:
                     os.kill(st["pid"], 0)
                     row["alive"] = True
-                except (OSError, KeyError):
-                    pass
+                except ProcessLookupError:
+                    row["stale_state"] = True  # dead pid: alive=False IS
+                    # the signal; marked so operators can tell a dead
+                    # worker from a never-started one
+                except (PermissionError, KeyError):
+                    # EPERM: the pid exists but isn't ours to signal
+                    row["alive"] = "pid" in st
             if i == wc.index:
                 row["volumes"] = sorted(self.store.volumes)
             rows.append(row)

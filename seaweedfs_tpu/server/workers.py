@@ -142,6 +142,15 @@ async def proxy_request(req, session, target: str, token: str):
     sibling's aiohttp app."""
     import aiohttp
     from aiohttp import web
+    from ..util import failpoints
+    try:
+        # chaos site: injected sibling-hop faults (FailpointError and
+        # FailpointDrop are OSErrors) take the same 502 path a crashed
+        # worker does, which is what trips the caller's breaker
+        await failpoints.fail("worker.proxy")
+    except OSError as e:
+        return web.json_response(
+            {"error": f"worker proxy to {target}: {e}"}, status=502)
     headers = {k: v for k, v in req.headers.items()
                if k.lower() not in _HOP_HEADERS
                and k.lower() != "accept-encoding"}
@@ -155,6 +164,7 @@ async def proxy_request(req, session, target: str, token: str):
             body = await req.read()
         else:
             body = req.content           # stream large/unsized bodies
+    resp = None
     try:
         async with session.request(
                 req.method, tls.url(target, req.path_qs),
@@ -176,6 +186,16 @@ async def proxy_request(req, session, target: str, token: str):
             await resp.write_eof()
             return resp
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        if resp is not None and resp.prepared:
+            # the sibling died MID-BODY: headers (and part of the
+            # body) are already on the wire — abort the connection so
+            # the client sees a transport error, never a 502 JSON
+            # spliced into the needle bytes
+            glog.warning("worker proxy to %s died mid-body: %s",
+                         target, e)
+            if req.transport is not None:
+                req.transport.close()
+            return resp
         return web.json_response(
             {"error": f"worker proxy to {target}: {e}"}, status=502)
 

@@ -17,6 +17,7 @@ from ..ec import gf
 from ..ec.ec_volume import EcVolume, NotFoundError as EcNotFound
 from ..ec.locate import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE
 from ..pb import messages as pb
+from ..util import failpoints
 from . import types as t
 from .needle import Needle
 from .super_block import ReplicaPlacement
@@ -231,6 +232,11 @@ class Store:
     # ---- data plane ----
 
     def write_needle(self, vid: int, n: Needle) -> tuple[int, int]:
+        # chaos site `store.write`: sits below BOTH http paths (aiohttp
+        # handlers and the raw fasthttp protocol), so injected write
+        # faults hit every wire shape. One dict-emptiness check when
+        # disarmed.
+        failpoints.sync_fail("store.write")
         v = self.volumes.get(vid)
         if v is None:
             raise NotFound(f"volume {vid} not found")
@@ -238,6 +244,7 @@ class Store:
 
     def read_needle(self, vid: int, needle_id: int,
                     cookie: int | None = None) -> Needle:
+        failpoints.sync_fail("store.read")  # chaos site (see store.write)
         v = self.volumes.get(vid)
         if v is not None:
             try:

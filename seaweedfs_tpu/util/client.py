@@ -3,11 +3,19 @@
 Reference: weed/operation/ (assign_file_id.go, upload_content.go,
 lookup.go w/ 10-min vid cache, delete_content.go batch deletes) and
 weed/wdclient/ (cached master client).
+
+Resilience (beyond the reference): every hop runs under a RetryPolicy
+(exponential backoff, full jitter, deadlines, shared retry budget) and
+a per-upstream CircuitBreaker (util/resilience.py), and reads stream
+with mid-flight replica failover — a volume server dying mid-body
+rotates to the next location and resumes via a Range request instead
+of failing the read.
 """
 
 from __future__ import annotations
 
 from ..security import tls
+from .resilience import BreakerRegistry, RetryBudget, RetryPolicy
 
 import asyncio
 import time
@@ -16,7 +24,22 @@ import aiohttp
 
 
 class OperationError(Exception):
-    pass
+    """`retryable=True` marks failures a caller can sensibly retry at
+    a HIGHER level (fresh assign, different upstream): transport
+    errors, 5xx exhaustion, open circuits — never 4xx."""
+
+    def __init__(self, msg: object, retryable: bool = False):
+        super().__init__(msg)
+        self.retryable = retryable
+
+
+# Per-request timeouts: connect must fail fast (a dead peer's SYN
+# blackhole), sock_read guards mid-transfer stalls, and total stays
+# unbounded for genuinely large streaming bodies — the old single
+# total=120 session timeout let one stalled peer wedge an upload for
+# two minutes.
+MASTER_TIMEOUT = aiohttp.ClientTimeout(total=30, connect=5, sock_read=15)
+DATA_TIMEOUT = aiohttp.ClientTimeout(total=None, connect=10, sock_read=60)
 
 
 def parse_master_seeds(master_url: str) -> list[str]:
@@ -29,7 +52,9 @@ class WeedClient:
     def __init__(self, master_url: str,
                  session: aiohttp.ClientSession | None = None,
                  lookup_cache_ttl: float = 600.0,
-                 jwt_key: str = ""):
+                 jwt_key: str = "",
+                 retry: RetryPolicy | None = None,
+                 breakers: BreakerRegistry | None = None):
         # comma-separated seed list: like the reference's wdclient, a
         # dead master must not strand the client — master requests
         # rotate through the surviving seeds (masterclient.go:45-119)
@@ -46,11 +71,16 @@ class WeedClient:
         # (filer, chunk GC) mint their own tokens with the shared key
         self.jwt_key = jwt_key
         self._master_client = None  # optional wdclient (attach_master_client)
+        self.budget = RetryBudget()
+        self.retry = retry or RetryPolicy(max_attempts=4, base_delay=0.05,
+                                          max_delay=2.0, total_timeout=30.0,
+                                          budget=self.budget)
+        self.breakers = breakers or BreakerRegistry(
+            threshold=5, reset_timeout=5.0)
 
     async def __aenter__(self) -> "WeedClient":
         if self._session is None:
-            self._session = tls.make_session(
-                timeout=aiohttp.ClientTimeout(total=120))
+            self._session = tls.make_session(timeout=DATA_TIMEOUT)
         return self
 
     async def __aexit__(self, *exc) -> None:
@@ -84,26 +114,40 @@ class WeedClient:
     async def _master_get(self, path: str, params: dict) -> dict:
         """GET against the current master, rotating through the seed
         list when the master is unreachable (a killed leader must not
-        strand single-seed-configured clients mid-failover)."""
+        strand single-seed-configured clients mid-failover); unreachable
+        rounds retry with backoff under the policy, and each seed sits
+        behind its own circuit breaker so a long-dead master costs
+        microseconds, not connect timeouts."""
         last: object = None
-        for _ in range(max(1, len(self.master_seeds))):
-            try:
-                async with self.http.get(
-                        tls.url(self.master_url, path),
-                        params=params) as resp:
-                    body = await resp.json()
-                    if resp.status in (502, 503):
-                        # reachable follower proxying a dead leader /
-                        # no leader yet: the NEXT seed may already be
-                        # the new leader
-                        last = body.get("error", f"http {resp.status}")
-                        self._rotate_seed()
-                        continue
-                    return body
-            except (aiohttp.ClientError, asyncio.TimeoutError,
-                    OSError) as e:
-                last = e
-                self._rotate_seed()
+        async for _ in self.retry.attempts():
+            for _ in range(max(1, len(self.master_seeds))):
+                br = self.breakers.get(f"master:{self.master_url}")
+                if not br.allow():
+                    last = last or f"master {self.master_url} circuit open"
+                    self._rotate_seed()
+                    continue
+                try:
+                    async with self.http.get(
+                            tls.url(self.master_url, path),
+                            params=params,
+                            timeout=MASTER_TIMEOUT) as resp:
+                        body = await resp.json()
+                        if resp.status in (502, 503):
+                            # reachable follower proxying a dead leader /
+                            # no leader yet: the NEXT seed may already be
+                            # the new leader
+                            last = body.get("error",
+                                            f"http {resp.status}")
+                            br.record_success()   # reachable, not broken
+                            self._rotate_seed()
+                            continue
+                        br.record_success()
+                        return body
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError) as e:
+                    last = e
+                    br.record_failure()
+                    self._rotate_seed()
         raise OperationError(f"master unreachable: {last}")
 
     def _rotate_seed(self) -> None:
@@ -159,17 +203,40 @@ class WeedClient:
     async def upload(self, fid: str, url: str, data: bytes,
                      mime: str = "", ttl: str = "",
                      auth: str = "") -> dict:
+        """Upload with bounded retries: 5xx and transport errors back
+        off and retry (the write is idempotent — same fid, same bytes);
+        4xx fail immediately. The volume upstream sits behind a
+        breaker so a dead server sheds load fast."""
         params = {"ttl": ttl} if ttl else {}
         headers = {"Content-Type": mime} if mime else {}
         token = auth or self._mint_jwt(fid)
         if token:
             headers["Authorization"] = f"Bearer {token}"
-        async with self.http.post(tls.url(url, f"/{fid}"), data=data,
-                                  params=params, headers=headers) as resp:
-            body = await resp.json()
-            if resp.status not in (200, 201):
-                raise OperationError(f"upload {fid}: {body}")
-            return body
+        br = self.breakers.get(url)
+        last: object = None
+        async for _ in self.retry.attempts():
+            if not br.allow():
+                last = last or f"upload {fid}: {url} circuit open"
+                break
+            try:
+                async with self.http.post(
+                        tls.url(url, f"/{fid}"), data=data,
+                        params=params, headers=headers,
+                        timeout=DATA_TIMEOUT) as resp:
+                    body = await resp.json()
+                    if resp.status in (200, 201):
+                        br.record_success()
+                        return body
+                    if resp.status < 500:
+                        br.record_success()   # server healthy, we erred
+                        raise OperationError(f"upload {fid}: {body}")
+                    last = f"upload {fid}: {body}"
+                    br.record_failure()
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError, ValueError) as e:
+                last = f"upload {fid}: {type(e).__name__} {e}"
+                br.record_failure()
+        raise OperationError(str(last), retryable=True)
 
     async def upload_manifest(self, fid: str, url: str, manifest,
                               ttl: str = "", auth: str = "") -> dict:
@@ -184,7 +251,8 @@ class WeedClient:
             headers["Authorization"] = f"Bearer {token}"
         async with self.http.post(tls.url(url, f"/{fid}"),
                                   data=manifest.marshal(),
-                                  params=params, headers=headers) as resp:
+                                  params=params, headers=headers,
+                                  timeout=DATA_TIMEOUT) as resp:
             body = await resp.json()
             if resp.status not in (200, 201):
                 raise OperationError(f"upload manifest {fid}: {body}")
@@ -194,60 +262,128 @@ class WeedClient:
                           replication: str = "", ttl: str = "",
                           mime: str = "", data_center: str = "") -> str:
         """assign + upload (forwarding the assign's write token); returns
-        the fid."""
-        a = await self.assign(collection=collection,
-                              replication=replication, ttl=ttl,
-                              data_center=data_center)
-        await self.upload(a["fid"], a["url"], data, mime=mime, ttl=ttl,
-                          auth=a.get("auth", ""))
-        return a["fid"]
+        the fid. A retryable upload failure (dead/open-circuit target)
+        gets a FRESH assign — the master routes around the dead server
+        within a pulse or two, so re-assigning is what keeps writes
+        available through a node death instead of failing them fast."""
+        last: OperationError | None = None
+        for _ in range(3):
+            a = await self.assign(collection=collection,
+                                  replication=replication, ttl=ttl,
+                                  data_center=data_center)
+            try:
+                await self.upload(a["fid"], a["url"], data, mime=mime,
+                                  ttl=ttl, auth=a.get("auth", ""))
+                return a["fid"]
+            except OperationError as e:
+                if not e.retryable:
+                    raise
+                last = e
+        raise last
 
-    async def read(self, fid: str, offset: int = 0,
-                   size: int = -1) -> bytes:
-        """Read with location failover: every holder from the lookup is
-        tried (the reference's readUrl does the same across replicas /
-        EC shard holders); a dead first holder must not fail the read.
-        On a full miss the cached locations are invalidated and one
-        fresh lookup retries — a killed server stays in the 10-min vid
-        cache otherwise."""
+    async def read_stream(self, fid: str, offset: int = 0,
+                          size: int = -1):
+        """Async-generate the bytes of a needle with DEGRADED-READ
+        FAILOVER: every holder from the lookup is tried; a holder that
+        dies MID-BODY does not fail the read — the stream rotates to
+        the next location and resumes from the exact byte reached, via
+        a Range request. On a full miss the cached locations are
+        invalidated and one fresh lookup retries (a killed server stays
+        in the 10-min vid cache otherwise). Open breakers demote a
+        location to last place rather than skipping it outright — shed
+        load first, but never turn a readable file into an error.
+
+        A clean short body (server's Content-Length honored) ends the
+        stream normally — sparse/short chunks stay the caller's
+        zero-fill business, exactly as before."""
         vid = fid.split(",")[0]
-        headers = {}
-        if offset or size >= 0:
-            end = "" if size < 0 else str(offset + size - 1)
-            headers["Range"] = f"bytes={offset}-{end}"
+        sent = 0                    # bytes already yielded
         last: str = "no locations"
-        for attempt in range(2):
+        stalled = 0
+        while stalled < 2:
+            # keep rotating while bytes ADVANCE (every replica may be
+            # flaky under injected faults); give up only after two
+            # consecutive lookup rounds with zero forward progress
+            round_start = sent
             try:
                 locs = await self.lookup(vid)
             except OperationError as e:
                 last = str(e)
                 break
+            # blocking() is a side-effect-free peek — allow() here
+            # would consume half-open probes for locations the read
+            # may never touch, wedging recovered upstreams half-open
+            locs = sorted(locs, key=lambda l: self.breakers.get(
+                l.get("publicUrl", l.get("url", ""))).blocking())
             for loc in locs:
-                url = tls.url(loc["publicUrl"], f"/{fid}")
+                upstream = loc.get("publicUrl", loc.get("url", ""))
+                url = tls.url(upstream, f"/{fid}")
+                br = self.breakers.get(upstream)
+                cur = offset + sent
+                headers = {}
+                if cur or size >= 0:
+                    end = "" if size < 0 else str(offset + size - 1)
+                    headers["Range"] = f"bytes={cur}-{end}"
                 try:
-                    async with self.http.get(url, headers=headers) as resp:
+                    async with self.http.get(
+                            url, headers=headers,
+                            timeout=DATA_TIMEOUT) as resp:
                         if resp.status in (404, 410):
                             # authoritative: the holder says it is gone
+                            br.record_success()
                             raise OperationError(f"read {fid}: not found")
-                        data = await resp.read()
                         if resp.status >= 400:
                             # an error body must never masquerade as
                             # file content; 5xx => try the next holder
+                            body = await resp.read()
                             last = (f"http {resp.status} "
-                                    f"{data[:200].decode(errors='replace')}")
+                                    f"{body[:200].decode(errors='replace')}")
+                            if resp.status >= 500:
+                                br.record_failure()
+                            else:
+                                br.record_success()
                             continue
+                        # server ignored Range (200 to a mid-file
+                        # resume): skip the prefix we already delivered
+                        skip = cur if resp.status == 200 else 0
+                        async for chunk in resp.content.iter_chunked(
+                                1 << 16):
+                            if skip:
+                                if len(chunk) <= skip:
+                                    skip -= len(chunk)
+                                    continue
+                                chunk = chunk[skip:]
+                                skip = 0
+                            if size >= 0:
+                                remain = size - sent
+                                if len(chunk) > remain:
+                                    chunk = chunk[:remain]
+                            if chunk:
+                                sent += len(chunk)
+                                yield chunk
+                            if size >= 0 and sent >= size:
+                                break
+                        br.record_success()
+                        return
                 except (aiohttp.ClientError, asyncio.TimeoutError,
                         OSError) as e:
+                    # mid-body deaths land here (aiohttp raises
+                    # ClientPayloadError when the peer dies before
+                    # Content-Length is satisfied): rotate and resume
                     last = f"{type(e).__name__} {e}"
+                    br.record_failure()
                     continue
-                if resp.status == 200 and (offset or size >= 0):
-                    # server ignored Range; slice locally
-                    data = data[offset:offset + size if size >= 0
-                                else None]
-                return data
-            if attempt == 0:
-                self.invalidate(vid)  # stale holders: refresh + retry
+            stalled = stalled + 1 if sent == round_start else 0
+            self.invalidate(vid)    # stale holders: refresh + retry
         raise OperationError(f"read {fid}: {last}")
+
+    async def read(self, fid: str, offset: int = 0,
+                   size: int = -1) -> bytes:
+        """Read with location failover (buffered form of read_stream)."""
+        parts = []
+        async for chunk in self.read_stream(fid, offset, size):
+            parts.append(chunk)
+        return b"".join(parts)
 
     async def delete_fids(self, fids: list[str]) -> int:
         """Batch delete grouped per volume server
@@ -272,9 +408,11 @@ class WeedClient:
                     async with self.http.delete(
                             tls.url(server, f"/{fid}"),
                             params={"type": "replicate"},
-                            headers=headers) as resp:
+                            headers=headers,
+                            timeout=DATA_TIMEOUT) as resp:
                         n += resp.status == 200
-                except aiohttp.ClientError:
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError):
                     pass
             return n
 
@@ -282,13 +420,20 @@ class WeedClient:
             # one round trip per holding server via the batch endpoint
             # (volume_grpc_batch_delete.go analog), with per-fid write
             # tokens when the cluster enforces them
+            br = self.breakers.get(server)
+            if not br.allow():
+                return 0            # dead server: fail fast, not timeout
             payload: dict = {"fileIds": batch}
             if self.jwt_key:
                 payload["tokens"] = {f: self._mint_jwt(f) for f in batch}
             try:
                 async with self.http.post(
                         tls.url(server, "/admin/batch_delete"),
-                        json=payload) as resp:
+                        json=payload, timeout=DATA_TIMEOUT) as resp:
+                    # the probe consumed by allow() MUST be resolved on
+                    # every path — an unrecorded outcome wedges the
+                    # breaker half-open forever
+                    br.record_success()   # reachable (any status)
                     if resp.status == 200:
                         res = (await resp.json()).get("results", [])
                         ok = sum(r.get("status") in (200, 202)
@@ -301,8 +446,9 @@ class WeedClient:
                         if retry:
                             ok += await drop_one_by_one(server, retry)
                         return ok
-            except (aiohttp.ClientError, ValueError):
-                pass
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                    ValueError):
+                br.record_failure()
             # endpoint unavailable: per-fid tombstones
             return await drop_one_by_one(server, batch)
 

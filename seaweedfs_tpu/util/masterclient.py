@@ -15,6 +15,8 @@ moves and node deaths.
 from __future__ import annotations
 
 from ..security import tls
+from . import glog
+from .resilience import Backoff
 
 import asyncio
 import json
@@ -47,6 +49,7 @@ class MasterClient:
         self._rr: dict[int, int] = {}
         self._task: asyncio.Task | None = None
         self._synced = asyncio.Event()
+        self._stream_synced = False
 
     async def start(self) -> None:
         if self._session is None:
@@ -114,11 +117,19 @@ class MasterClient:
 
     async def _keep_connected(self) -> None:
         i = 0
+        # full-jitter exponential backoff between reconnect rounds: a
+        # fixed 1s cadence from a whole fleet of watchers re-dials a
+        # rebooting master in lockstep (resilience.Backoff resets once
+        # a stream delivers its snapshot)
+        backoff = Backoff(base=0.25, cap=10.0)
         while True:
             master = self.current_master
             redirected = False
+            self._stream_synced = False
             try:
                 await self._consume_stream(master)
+                glog.V(1).infof("masterclient %s: watch stream to %s "
+                                "ended", self.name, master)
             except asyncio.CancelledError:
                 raise
             except _LeaderRedirect:
@@ -128,14 +139,27 @@ class MasterClient:
                 # window) can't drive a tight reconnect loop
                 redirected = True
                 await asyncio.sleep(0.2)
-            except Exception:
-                pass
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                    RuntimeError, ValueError) as e:
+                # ValueError covers a malformed NDJSON line; a swallowed
+                # stream death must at least be visible at -v 1
+                glog.V(1).infof("masterclient %s: watch stream to %s "
+                                "failed: %s", self.name, master, e)
+            except Exception as e:  # noqa: BLE001 — the watcher must
+                # NEVER die: an unexpected update shape (KeyError in
+                # _apply, non-dict JSON) would otherwise kill this task
+                # silently and freeze the vid map for the process life
+                glog.warning("masterclient %s: watch stream to %s: "
+                             "unexpected %s: %s", self.name, master,
+                             type(e).__name__, e)
+            if self._stream_synced:
+                backoff.reset()     # that stream was healthy once
             if not redirected:
                 # rotate to the next configured master (leader chasing:
                 # tryConnectToMaster redirect loop)
                 i += 1
                 self.current_master = self.masters[i % len(self.masters)]
-                await asyncio.sleep(1.0)
+                await asyncio.sleep(backoff.next())
 
     async def _consume_stream(self, master: str) -> None:
         async with self._session.get(
@@ -156,6 +180,7 @@ class MasterClient:
                     if update.get("synced"):
                         # end-of-snapshot marker: map is now complete
                         self._synced.set()
+                        self._stream_synced = True
                         continue
                     if update.get("leader"):
                         # explicit leader hint (sent by non-leader masters

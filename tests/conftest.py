@@ -27,6 +27,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running soak/integration tests excluded "
+        "from the tier-1 run (-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

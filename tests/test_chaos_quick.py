@@ -1,0 +1,30 @@
+"""Chaos smoke (marked slow — excluded from tier-1): a short
+tools/chaos.py run with real subprocesses, armed failpoints and a
+volume-server SIGKILL must finish with zero acknowledged-write loss."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_chaos_quick(tmp_path):
+    report_path = str(tmp_path / "chaos.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "--quick", "--json", report_path],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=420)
+    sys.stdout.write(out.stdout)
+    sys.stderr.write(out.stderr)
+    assert out.returncode == 0, "chaos soak failed"
+    with open(report_path) as f:
+        report = json.load(f)
+    assert report["verdict"] == "PASS"
+    assert report["lost"] == 0
+    assert report["stats"]["writes_ok"] > 0

@@ -1,0 +1,67 @@
+"""Tier-1 gate: no silently-swallowed broad exceptions in the data
+plane (tools/lint_robustness.py), and the lint itself catches the
+shapes it claims to."""
+
+import os
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from lint_robustness import lint_file, lint_paths  # noqa: E402
+
+
+def test_server_tree_is_clean():
+    problems = lint_paths(
+        [os.path.join(REPO, "seaweedfs_tpu", "server")])
+    assert problems == []
+
+
+def test_util_and_master_are_clean():
+    problems = lint_paths([
+        os.path.join(REPO, "seaweedfs_tpu", "util"),
+        os.path.join(REPO, "seaweedfs_tpu", "master"),
+    ])
+    assert problems == []
+
+
+def test_lint_catches_silent_broad_handlers(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+            try:
+                g()
+            except:
+                pass
+            for _ in range(3):
+                try:
+                    g()
+                except (ValueError, Exception):
+                    continue
+    """))
+    problems = lint_file(str(bad))
+    assert len(problems) == 3
+    assert "except Exception" in problems[0]
+    assert "bare except" in problems[1]
+
+
+def test_lint_allows_narrow_and_logged_handlers(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text(textwrap.dedent("""
+        import logging
+        def f():
+            try:
+                g()
+            except ValueError:
+                pass                      # narrow: allowed
+            try:
+                g()
+            except Exception as e:
+                logging.warning("boom %s", e)   # logged: allowed
+    """))
+    assert lint_file(str(ok)) == []

@@ -283,11 +283,22 @@ class _Fleet:
               "-dir", os.path.join(self.tmp, "v"), "-max", "20",
               "-master", f"127.0.0.1:{self.mport}",
               "-pulseSeconds", "1", "-workers", str(self.workers))
-        _wait(lambda: json.loads(_get(
-            f"http://127.0.0.1:{self.mport}/dir/assign"))["fid"])
-        # both workers registered (state files + live pids)
-        _wait(lambda: self.worker_rows() and all(
-            w["alive"] for w in self.worker_rows()))
+        try:
+            # generous budget: under a full-suite run on a throttled
+            # container, 4 subprocesses each importing jax can take a
+            # long time to come up
+            _wait(lambda: json.loads(_get(
+                f"http://127.0.0.1:{self.mport}/dir/assign"))["fid"],
+                tries=150)
+            # both workers registered (state files + live pids)
+            _wait(lambda: self.worker_rows() and all(
+                w["alive"] for w in self.worker_rows()), tries=100)
+        except BaseException:
+            # __exit__ never runs when __enter__ raises: a leaked fleet
+            # would squat the SO_REUSEPORT port and poison every later
+            # test that reuses it (the kernel balances onto zombies)
+            self.__exit__()
+            raise
         return self
 
     def __exit__(self, *exc) -> None:
@@ -445,8 +456,13 @@ def test_master_workers_wire(tmp_path):
         keys = set()
         payload = None
         for i in range(30):
-            a = json.loads(_get(
-                f"http://127.0.0.1:{mport}/dir/assign"))
+            # _wait: a 503 during the primary's election / the
+            # accelerator's lease-refill window is a transient a real
+            # client retries; key uniqueness below still catches any
+            # actual assign regression
+            a = _wait(lambda: json.loads(_get(
+                f"http://127.0.0.1:{mport}/dir/assign")),
+                tries=20, delay=0.2)
             key = a["fid"].split(",")[1][:-8]
             assert key not in keys, f"duplicate file key {a['fid']}"
             keys.add(key)

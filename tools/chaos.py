@@ -1,0 +1,347 @@
+"""Failpoint-driven chaos soak: prove the resilience layer end to end.
+
+Boots a real CLI cluster (master + volume fleet on private ports), arms
+failpoints over the live /debug/failpoints admin endpoint (5% injected
+read/write errors, latency spikes, mid-body truncations, replication
+fan-out faults), runs a mixed write/read/delete workload, SIGKILLs one
+volume server mid-run, and then asserts the two invariants that define
+user-visible durability and availability:
+
+  1. ZERO acknowledged-write loss — every fid whose upload was ACKed
+     (and not deliberately deleted) reads back byte-identical at the
+     end, through location failover past the killed server.
+  2. BOUNDED client-observed error rate — retries + breakers must
+     absorb the injected 5% fault rate; the workload's post-retry
+     error rate must stay under --error-bound.
+
+    python tools/chaos.py            # full soak (~60s of load)
+    python tools/chaos.py --quick    # CI smoke (~10s of load)
+
+Exit code 0 only when both invariants hold.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASE_PORT = 23400
+
+# what gets armed on every volume server (spec grammar:
+# action[=arg][:count][@probability] — util/failpoints.py)
+VOLUME_FAILPOINTS = {
+    "store.read": "error@0.04",
+    "store.write": "error@0.04",
+    "volume.read.http": "truncate=0.5@0.25",
+    "volume.replicate": "error@0.03",
+}
+VOLUME_LATENCY = {"store.read": "latency=80@0.05"}  # alternate arming
+MASTER_FAILPOINTS = {"master.assign": "latency=50@0.05"}
+
+
+class Procs:
+    def __init__(self, tmp: str):
+        self.tmp = tmp
+        self.procs: list[subprocess.Popen] = []
+        self.env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+
+    def spawn(self, *args: str) -> subprocess.Popen:
+        log = open(os.path.join(self.tmp, f"proc{len(self.procs)}.log"),
+                   "w")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", *args],
+            stdout=log, stderr=subprocess.STDOUT, env=self.env, cwd=REPO)
+        self.procs.append(p)
+        return p
+
+    def kill_all(self) -> None:
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in self.procs:
+            p.wait(timeout=10)
+
+
+def wait_assign(master: str, params: str = "", tries: int = 45) -> None:
+    for _ in range(tries):
+        try:
+            with urllib.request.urlopen(
+                    f"http://{master}/dir/assign?{params}",
+                    timeout=3) as r:
+                if b"fid" in r.read():
+                    return
+        except OSError:
+            pass
+        time.sleep(1)
+    raise RuntimeError("cluster never became assignable")
+
+
+def http_json(url: str, method: str = "GET",
+              timeout: float = 5.0) -> dict:
+    req = urllib.request.Request(url, method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+def arm(addr: str, specs: dict[str, str], path="/debug/failpoints") -> None:
+    for site, spec in specs.items():
+        out = http_json(f"http://{addr}{path}?site={site}&spec={spec}",
+                        method="POST")
+        assert any(a["site"] == site for a in out.get("armed", [])), out
+
+
+class Stats:
+    def __init__(self):
+        self.writes_ok = 0
+        self.writes_err = 0
+        self.reads_ok = 0
+        self.reads_err = 0
+        self.deletes = 0
+
+    @property
+    def ops(self) -> int:
+        return self.writes_ok + self.writes_err + \
+            self.reads_ok + self.reads_err
+
+    @property
+    def errors(self) -> int:
+        return self.writes_err + self.reads_err
+
+    def to_dict(self) -> dict:
+        rate = self.errors / self.ops if self.ops else 0.0
+        return {"writes_ok": self.writes_ok, "writes_err": self.writes_err,
+                "reads_ok": self.reads_ok, "reads_err": self.reads_err,
+                "deletes": self.deletes,
+                "client_error_rate": round(rate, 4)}
+
+
+async def workload(master: str, duration: float, concurrency: int,
+                   stats: Stats, acked: dict, deleted: set,
+                   rng: random.Random, kill_at: float,
+                   kill_fn) -> None:
+    from seaweedfs_tpu.util.client import OperationError, WeedClient
+    stop_at = time.monotonic() + duration
+    killed = False
+    lock = asyncio.Lock()
+
+    async with WeedClient(master) as c:
+        async def worker(wid: int) -> None:
+            nonlocal killed
+            while time.monotonic() < stop_at:
+                roll = rng.random()
+                try:
+                    if roll < 0.45 or not acked:
+                        data = rng.randbytes(rng.randint(400, 24000))
+                        fid = await c.upload_data(data,
+                                                  replication="001")
+                        async with lock:
+                            acked[fid] = data
+                            stats.writes_ok += 1
+                    elif roll < 0.9:
+                        fid = rng.choice(list(acked))
+                        want = acked.get(fid)
+                        try:
+                            got = await c.read(fid)
+                        except OperationError:
+                            if fid in deleted:
+                                continue   # raced a deleter: benign
+                            raise
+                        # re-check: a deleter may have tombstoned it
+                        # between our pick and the read completing
+                        if fid in deleted:
+                            continue
+                        if want is not None and got != want:
+                            raise OperationError(
+                                f"payload mismatch {fid}: "
+                                f"{len(got)} vs {len(want)}")
+                        stats.reads_ok += 1
+                    else:
+                        fid = rng.choice(list(acked))
+                        async with lock:
+                            if fid not in acked:
+                                continue
+                            del acked[fid]
+                            deleted.add(fid)
+                        await c.delete_fids([fid])
+                        stats.deletes += 1
+                except Exception as e:  # noqa: BLE001 — every failure counts
+                    if roll < 0.45:
+                        stats.writes_err += 1
+                    else:
+                        stats.reads_err += 1
+                    if stats.errors <= 5:
+                        print(f"  [w{wid}] op error: "
+                              f"{type(e).__name__} {str(e)[:120]}")
+                await asyncio.sleep(0)
+
+        async def killer() -> None:
+            nonlocal killed
+            await asyncio.sleep(kill_at)
+            kill_fn()
+            killed = True
+
+        await asyncio.gather(killer(),
+                             *(worker(i) for i in range(concurrency)))
+
+
+async def final_verify(master: str, acked: dict) -> list[str]:
+    """Every acknowledged, undeleted write must read back byte-identical
+    — through failover, with a patient fresh client."""
+    from seaweedfs_tpu.util.client import WeedClient
+    from seaweedfs_tpu.util.resilience import RetryPolicy
+    lost: list[str] = []
+    sem = asyncio.Semaphore(16)
+    async with WeedClient(master, retry=RetryPolicy(
+            max_attempts=6, base_delay=0.2, total_timeout=60)) as c:
+
+        async def check(fid: str, want: bytes) -> None:
+            async with sem:
+                for attempt in range(4):
+                    try:
+                        got = await c.read(fid)
+                        if got == want:
+                            return
+                        lost.append(f"{fid}: MISMATCH {len(got)} vs "
+                                    f"{len(want)}")
+                        return
+                    except Exception as e:  # noqa: BLE001
+                        if attempt == 3:
+                            lost.append(f"{fid}: {type(e).__name__} "
+                                        f"{str(e)[:100]}")
+                            return
+                        await asyncio.sleep(0.5 * (attempt + 1))
+
+        await asyncio.gather(*(check(f, w) for f, w in acked.items()))
+    return lost
+
+
+async def run(args) -> int:
+    tmp = tempfile.mkdtemp(prefix="chaos_")
+    procs = Procs(tmp)
+    n_servers = 3
+    rng = random.Random(args.seed)
+    report: dict = {"mode": "quick" if args.quick else "soak"}
+    try:
+        master = f"127.0.0.1:{BASE_PORT}"
+        procs.spawn("master", "-port", str(BASE_PORT),
+                    "-mdir", os.path.join(tmp, "m"),
+                    "-volumeSizeLimitMB", "8", "-pulseSeconds", "1",
+                    "-defaultReplication", "001")
+        time.sleep(2)
+        for i in range(n_servers):
+            procs.spawn("volume", "-port", str(BASE_PORT + 1 + i),
+                        "-dir", os.path.join(tmp, f"v{i}"),
+                        "-max", "20", "-master", master,
+                        "-pulseSeconds", "1")
+        wait_assign(master, "replication=001")
+
+        # runtime arming over the live admin endpoint (this also IS the
+        # endpoint's integration test)
+        arm(master, MASTER_FAILPOINTS)
+        for i in range(n_servers):
+            addr = f"127.0.0.1:{BASE_PORT + 1 + i}"
+            arm(addr, VOLUME_FAILPOINTS)
+        # one server additionally gets latency spikes
+        arm(f"127.0.0.1:{BASE_PORT + 1}", VOLUME_LATENCY)
+        print(f"armed failpoints on master + {n_servers} volume servers")
+
+        stats = Stats()
+        acked: dict = {}
+        deleted: set = set()
+        duration = 10.0 if args.quick else 60.0
+        kill_at = duration * 0.5
+        victim = procs.procs[1 + n_servers - 1]   # last volume server
+
+        def kill_victim() -> None:
+            print(f"  SIGKILL volume server pid {victim.pid} "
+                  f"(port {BASE_PORT + n_servers})")
+            victim.send_signal(signal.SIGKILL)
+
+        t0 = time.monotonic()
+        await workload(master, duration, args.concurrency, stats,
+                       acked, deleted, rng, kill_at, kill_victim)
+        elapsed = time.monotonic() - t0
+
+        report["stats"] = stats.to_dict()
+        report["acked"] = len(acked)
+        report["deleted"] = len(deleted)
+        report["elapsed_s"] = round(elapsed, 1)
+        print(f"workload done in {elapsed:.1f}s: {report['stats']}")
+        if stats.writes_ok < (10 if args.quick else 100):
+            print("FAIL: workload acked too few writes to prove anything")
+            report["verdict"] = "FAIL(too few writes)"
+            return 1
+
+        # collect fired-failpoint + breaker evidence from survivors
+        fired = {}
+        for i in range(n_servers - 1):
+            addr = f"127.0.0.1:{BASE_PORT + 1 + i}"
+            try:
+                for a in http_json(
+                        f"http://{addr}/debug/failpoints")["failpoints"]:
+                    fired[a["site"]] = fired.get(a["site"], 0) + a["hits"]
+            except OSError:
+                pass
+        report["failpoint_hits"] = fired
+        print(f"failpoint hits (surviving servers): {fired}")
+        if not args.quick and not any(fired.values()):
+            print("FAIL: no failpoint ever fired — the chaos run "
+                  "tested nothing")
+            report["verdict"] = "FAIL(no faults injected)"
+            return 1
+
+        # invariant 1: zero acknowledged-write loss
+        lost = await final_verify(master, acked)
+        report["lost"] = len(lost)
+        for line in lost[:10]:
+            print("  LOST:", line)
+
+        # invariant 2: bounded client-observed error rate
+        rate = report["stats"]["client_error_rate"]
+        ok = not lost and rate <= args.error_bound
+        report["verdict"] = "PASS" if ok else "FAIL"
+        print(f"acked={len(acked)} lost={len(lost)} "
+              f"err_rate={rate:.3f} (bound {args.error_bound}) "
+              f"-> {report['verdict']}")
+        return 0 if ok else 1
+    finally:
+        procs.kill_all()
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=2)
+        if args.keep:
+            print("logs under", tmp)
+        else:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="~10s CI smoke instead of the full soak")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--error-bound", type=float, default=0.20,
+                    help="max post-retry client error rate")
+    ap.add_argument("--seed", type=int, default=1337)
+    ap.add_argument("--json", help="write the report to this path")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep tmpdir + server logs")
+    args = ap.parse_args()
+    return asyncio.run(run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

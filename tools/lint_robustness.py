@@ -1,0 +1,91 @@
+"""AST lint: forbid silently-swallowed broad exceptions.
+
+Flags any ``except`` handler that (a) catches ``Exception`` /
+``BaseException`` or is a bare ``except:``, AND (b) whose body is only
+``pass`` / ``continue`` — the shape that turns real faults invisible.
+Narrow handlers may still swallow (that is often correct: idempotent
+deletes, probe loops); broad ones must at least log.
+
+Run as a tier-1 test (tests/test_robustness_lint.py) over
+``seaweedfs_tpu/server/`` so the data plane can never regress, or by
+hand over any path:
+
+    python tools/lint_robustness.py [path ...]
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PATHS = [os.path.join(REPO, "seaweedfs_tpu", "server")]
+
+BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True                          # bare except:
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in BROAD:
+            return True
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(s, (ast.Pass, ast.Continue))
+               for s in handler.body)
+
+
+def lint_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+    problems = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
+                and _is_silent(node):
+            what = "bare except" if node.type is None \
+                else "except Exception"
+            problems.append(
+                f"{path}:{node.lineno}: silent {what}: pass — narrow "
+                f"the exception type and/or glog the fault")
+    return problems
+
+
+def lint_paths(paths: list[str]) -> list[str]:
+    problems: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            problems += lint_file(p)
+            continue
+        for root, _dirs, files in os.walk(p):
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    problems += lint_file(os.path.join(root, name))
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    paths = argv or DEFAULT_PATHS
+    problems = lint_paths(paths)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} silent broad exception handler(s)")
+        return 1
+    print("robustness lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
