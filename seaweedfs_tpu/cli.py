@@ -125,6 +125,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "toml mTLS the /admin mesh is cert-protected; "
                         "without it /admin mutations fall under this "
                         "list too (whitelist the master and peers)")
+    v.add_argument("-cache.mem", dest="cache_mem", type=int, default=32,
+                   help="total MB for volume-side read caches, split "
+                        "3/4 hot-needle + 1/4 EC reconstruction "
+                        "(strictly invalidated on write/delete/vacuum); "
+                        "0 disables all volume-side read caching")
 
     f = sub.add_parser("filer", help="start a filer server")
     _add_common(f)
@@ -148,6 +153,12 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-disableDirListing", action="store_true")
     f.add_argument("-dirListLimit", type=int, default=100_000,
                    help="cap on directory listing page size")
+    f.add_argument("-cache.mem", dest="cache_mem", type=int, default=64,
+                   help="MB of memory for the chunk read cache "
+                        "(0 disables)")
+    f.add_argument("-cache.dir", dest="cache_dir", default="",
+                   help="directory for the mmap-backed disk cache tier "
+                        "(empty = memory-only)")
 
     fc = sub.add_parser("filer.copy",
                         help="parallel-upload local files/trees to a filer")
@@ -193,6 +204,11 @@ def build_parser() -> argparse.ArgumentParser:
     s3p.add_argument("-domainName", default="",
                      help="enable virtual-host-style requests "
                           "(Host: bucket.<domainName>)")
+    s3p.add_argument("-cache.mem", dest="cache_mem", type=int, default=64,
+                     help="MB of memory for the chunk read cache "
+                          "(0 disables)")
+    s3p.add_argument("-cache.dir", dest="cache_dir", default="",
+                     help="directory for the mmap-backed disk cache tier")
 
     wd = sub.add_parser("webdav", help="start a WebDAV gateway")
     _add_common(wd)
@@ -203,6 +219,11 @@ def build_parser() -> argparse.ArgumentParser:
     wd.add_argument("-collection", default="")
     wd.add_argument("-replication", default="")
     wd.add_argument("-chunkSizeMB", type=int, default=16)
+    wd.add_argument("-cache.mem", dest="cache_mem", type=int, default=64,
+                    help="MB of memory for the chunk read cache "
+                         "(0 disables)")
+    wd.add_argument("-cache.dir", dest="cache_dir", default="",
+                    help="directory for the mmap-backed disk cache tier")
 
     srv = sub.add_parser("server",
                          help="combined master+volume+filer+s3 in one process")
@@ -261,6 +282,16 @@ def build_parser() -> argparse.ArgumentParser:
     bm.add_argument("-readSequentially", nargs="?", const="true",
                     default="false", choices=("true", "false"),
                     help="read fids in list order instead of shuffled")
+    bm.add_argument("-readMode", default="",
+                    choices=("", "shuffle", "sequential", "zipf"),
+                    help="read-order distribution; zipf = repeated "
+                         "hot-key reads (the cache-effectiveness "
+                         "workload; overrides -readSequentially)")
+    bm.add_argument("-readN", type=int, default=0,
+                    help="total read requests (0 = one per fid); with "
+                         "-readMode zipf the same hot fids repeat")
+    bm.add_argument("-zipfS", type=float, default=1.1,
+                    help="zipf exponent for -readMode zipf")
 
     bk = sub.add_parser("backup", help="incrementally back up one volume "
                                        "from a volume server to a local dir")
@@ -563,7 +594,8 @@ async def _run_volume(args) -> None:
                   * 1024 * 1024,
                   index_type=args.index,
                   partition=(None if worker_ctx is None else
-                             (worker_ctx.index, worker_ctx.total)))
+                             (worker_ctx.index, worker_ctx.total)),
+                  needle_cache_bytes=args.cache_mem * 1024 * 1024)
     vs = VolumeServer(store, args.master, ip=args.ip, port=args.port,
                       data_center=args.dataCenter, rack=args.rack,
                       pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
@@ -606,7 +638,9 @@ async def _run_filer(args) -> None:
                      data_center=args.dataCenter,
                      redirect_on_read=args.redirectOnRead,
                      disable_dir_listing=args.disableDirListing,
-                     dir_list_limit=args.dirListLimit)
+                     dir_list_limit=args.dirListLimit,
+                     cache_mem_bytes=args.cache_mem * 1024 * 1024,
+                     cache_dir=args.cache_dir)
     await fs.start()
     print(f"filer listening on {fs.url} (store={args.store})")
     await _serve_until_interrupt(fs)
@@ -792,7 +826,9 @@ async def _run_s3(args) -> None:
     _attach_discovered_queue(filer)
     s3 = S3Gateway(filer, args.master,
                    ip=args.ip, port=args.port, identities=identities,
-                   domain_name=args.domainName)
+                   domain_name=args.domainName,
+                   cache_mem_bytes=args.cache_mem * 1024 * 1024,
+                   cache_dir=args.cache_dir)
     await s3.start()
     print(f"s3 gateway listening on {s3.url}")
     await _serve_until_interrupt(s3)
@@ -808,7 +844,9 @@ async def _run_webdav(args) -> None:
                       ip=args.ip, port=args.port,
                       collection=args.collection,
                       replication=args.replication,
-                      chunk_size=args.chunkSizeMB * 1024 * 1024)
+                      chunk_size=args.chunkSizeMB * 1024 * 1024,
+                      cache_mem_bytes=args.cache_mem * 1024 * 1024,
+                      cache_dir=args.cache_dir)
     await wd.start()
     print(f"webdav listening on {wd.url} (store={args.store})")
     await _serve_until_interrupt(wd)
@@ -825,7 +863,8 @@ async def _run_server(args) -> None:
 
     m = MasterServer(ip=args.ip, port=args.masterPort, jwt_key=args.jwtKey)
     await m.start()
-    store = Store([args.dir])
+    # combined mode gets the standalone daemons' default cache budgets
+    store = Store([args.dir], needle_cache_bytes=32 << 20)
     vs = VolumeServer(store, m.url, ip=args.ip, port=args.volumePort,
                       jwt_key=args.jwtKey)
     await vs.start()
@@ -838,7 +877,8 @@ async def _run_server(args) -> None:
                                path=os.path.join(args.dir, "filer.db"))
         _attach_discovered_queue(combined_filer)
         filer_srv = FilerServer(
-            combined_filer, m.url, ip=args.ip, port=args.filerPort)
+            combined_filer, m.url, ip=args.ip, port=args.filerPort,
+            cache_mem_bytes=64 << 20)
         await filer_srv.start()
         parts.append(f"filer={filer_srv.url}")
     if args.s3:
@@ -1107,10 +1147,27 @@ async def _run_benchmark(args) -> None:
                 f.write("\n".join(fids) + "\n")
 
     rdt = 0.0
+    n_reads = 0
     if do_read and fids:
-        order = list(fids)
-        if args.readSequentially != "true":
+        mode = args.readMode or ("sequential"
+                                 if args.readSequentially == "true"
+                                 else "shuffle")
+        if mode == "zipf":
+            # zipf over a shuffled ranking: rank r drawn with weight
+            # 1/r^s, so a small hot set dominates — the classic
+            # read-mostly object-store mix the caches target
+            ranked = list(fids)
+            rng.shuffle(ranked)
+            weights = [1.0 / (r + 1) ** args.zipfS
+                       for r in range(len(ranked))]
+            order = rng.choices(ranked, weights=weights,
+                                k=args.readN or len(ranked))
+        elif mode == "sequential":
+            order = list(fids)
+        else:
+            order = list(fids)
             rng.shuffle(order)
+        n_reads = len(order)
         t0 = time.perf_counter()
         await asyncio.gather(*(worker("read", order)
                                for _ in range(args.concurrency)))
@@ -1130,7 +1187,7 @@ async def _run_benchmark(args) -> None:
     if do_read and fids:
         # measured bytes, not -size: a -write=false run may read fids
         # written with a different size
-        print(f"read:  {len(fids) / rdt:.1f} req/s, "
+        print(f"read:  {n_reads / rdt:.1f} req/s, "
               f"{read_bytes / rdt / 1024:.1f} KB/s")
         print(f"  latency ms p50/p95/p99/max: {pct(read_lat, 50):.1f}/"
               f"{pct(read_lat, 95):.1f}/{pct(read_lat, 99):.1f}/"

@@ -45,10 +45,18 @@ class EcVolume:
                  large_block: int = LARGE_BLOCK_SIZE,
                  small_block: int = SMALL_BLOCK_SIZE,
                  encoder=None,
-                 fetch_remote: Callable[[int, int, int], bytes | None] | None = None):
+                 fetch_remote: Callable[[int, int, int], bytes | None] | None = None,
+                 recover_cache=None):
         self.dir = dirname
         self.collection = collection
         self.vid = vid
+        # degraded-read reconstruction cache (util/chunk_cache
+        # LruByteCache, usually shared store-wide): keys carry the vid
+        # so one cache serves every mounted EC volume. Shard bytes are
+        # immutable once written (deletes tombstone the .ecx, never the
+        # shards), so entries only go stale when shards are re-encoded
+        # — the Store drops this vid's keys on EC mount/unmount.
+        self._recover_cache = recover_cache
         self.version = version
         self.large_block = large_block
         self.small_block = small_block
@@ -134,7 +142,26 @@ class EcVolume:
 
     def _recover_interval(self, want_sid: int, offset: int, size: int) -> bytes:
         """Gather the same interval from >=10 other shards and decode
-        (recoverOneRemoteEcShardInterval, store_ec.go:319-373)."""
+        (recoverOneRemoteEcShardInterval, store_ec.go:319-373).
+
+        Hot intervals of a lost shard are served from the
+        reconstruction cache: repeated degraded reads of the same
+        needle reuse the decoded bytes instead of re-gathering ten
+        shards and re-running the GF(256) transform (the dominant
+        degraded-read cost — arxiv 2306.10528)."""
+        rc = self._recover_cache
+        key = (self.vid, want_sid, offset, size)
+        gen = None
+        if rc is not None:
+            cached = rc.get(key)
+            if cached is not None:
+                return cached
+            # generation snapshot BEFORE gathering (EcRecoverCache; a
+            # plain LruByteCache in tests has no generations): a
+            # re-encode/remount racing this reconstruction bumps it and
+            # the stale fill below is refused
+            if hasattr(rc, "generation"):
+                gen = rc.generation(self.vid)
         bufs: list[np.ndarray] = []
         rows: list[int] = []
         for sid in range(gf.TOTAL_SHARDS):
@@ -158,7 +185,13 @@ class EcVolume:
                         self.vid, want_sid, offset, size, rows)
         coeff = gf.shard_rows([want_sid], rows)
         out = _transform_buffers(self.encoder(size), coeff, bufs)
-        return np.asarray(out[0], np.uint8).tobytes()
+        data = np.asarray(out[0], np.uint8).tobytes()
+        if rc is not None:
+            if gen is not None:
+                rc.put_fenced(key, data, gen)
+            else:
+                rc.put(key, data)
+        return data
 
     def verify_parity(self, window_size: int = 4 << 20) -> dict:
         """Scrub: recompute RS(10,4) parity over every stripe window and

@@ -66,7 +66,12 @@ class S3Gateway:
                  ip: str = "127.0.0.1", port: int = 8333,
                  chunk_size: int = 8 * 1024 * 1024,
                  identities: dict[str, str] | None = None,
-                 domain_name: str = ""):
+                 domain_name: str = "",
+                 cache_mem_bytes: int = 0,
+                 cache_dir: str = ""):
+        # -cache.mem/-cache.dir chunk read cache (see FilerServer)
+        self.cache_mem_bytes = cache_mem_bytes
+        self.cache_dir = cache_dir
         # -domainName (s3api_server.go:35-37): virtual-host-style
         # addressing, Host: <bucket>.<domainName>
         self.domain_name = domain_name
@@ -116,7 +121,12 @@ class S3Gateway:
         return f"{self.ip}:{self.port}"
 
     async def start(self) -> None:
-        self.client = WeedClient(self.master_url)
+        cc = None
+        if self.cache_mem_bytes > 0:
+            from ..util.chunk_cache import TieredChunkCache
+            cc = TieredChunkCache(self.cache_mem_bytes,
+                                  disk_dir=self.cache_dir or None)
+        self.client = WeedClient(self.master_url, chunk_cache=cc)
         await self.client.__aenter__()
         # when standalone (no colocated FilerServer draining chunk GC),
         # run our own drain loop so deletes/overwrites reclaim blobs

@@ -260,10 +260,20 @@ class FastNeedleProtocol(asyncio.Protocol):
                 return
             self._finish(_R404_VOL)
             return
+        # hot-needle cache peek first: a hit answers on the event loop
+        # with zero disk I/O and no executor round-trip — the dominant
+        # per-request cost left on this path (BENCH_NEEDLE.md).
+        # count=False: whether this lookup counts depends on what the
+        # needle turns out to be — a pairs/gzip/manifest needle replays
+        # through aiohttp, which does its own (single) accounting
+        n = vs.store.cached_needle(fid.volume_id, fid.key, fid.cookie,
+                                   count=False)
+        from_cache = n is not None
         try:
-            n = await asyncio.get_running_loop().run_in_executor(
-                None, vs.store.read_needle,
-                fid.volume_id, fid.key, fid.cookie)
+            if n is None:
+                n = await asyncio.get_running_loop().run_in_executor(
+                    None, vs.store.read_needle,
+                    fid.volume_id, fid.key, fid.cookie)
         except (NotFound, AlreadyDeleted):
             vs.count("read", "404")
             self._finish(_R404)
@@ -289,9 +299,13 @@ class FastNeedleProtocol(asyncio.Protocol):
             return
         if n.pairs or n.is_chunked_manifest or n.is_gzipped:
             # pairs->headers / manifest assembly / gzip negotiation:
-            # re-serve this request through the full handler
+            # re-serve this request through the full handler (which
+            # counts the cache hit/miss for this request itself)
             self._upgrade_replay(b"GET", fid_s, headers)
             return
+        if from_cache:
+            # deferred accounting for the served fast-path hit
+            vs.store.needle_cache.hit(n)
         vs.count("read", "ok")
         body = n.data
         ct = n.mime.decode() if n.mime else "application/octet-stream"

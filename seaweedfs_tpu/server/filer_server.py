@@ -32,7 +32,13 @@ class FilerServer:
                  data_center: str = "",
                  redirect_on_read: bool = False,
                  disable_dir_listing: bool = False,
-                 dir_list_limit: int = 100_000):
+                 dir_list_limit: int = 100_000,
+                 cache_mem_bytes: int = 0,
+                 cache_dir: str = ""):
+        # -cache.mem/-cache.dir: tiered whole-chunk read cache riding
+        # the WeedClient (util/chunk_cache); 0 disables
+        self.cache_mem_bytes = cache_mem_bytes
+        self.cache_dir = cache_dir
         self.filer = filer
         self.master_url = master_url
         self.ip = ip
@@ -80,6 +86,10 @@ class FilerServer:
         from ..util import failpoints
         app.router.add_route("*", "/__debug__/failpoints",
                              failpoints.handle_debug)
+        # reserved-prefix path (like /__api__, /__debug__) so a stored
+        # file named /metrics is never shadowed; exposes the chunk-cache
+        # hit/miss/byte counters among the rest of the registry
+        app.router.add_get("/__metrics__", self.h_metrics)
         app.router.add_route("GET", "/{path:.*}", self.h_get)
         app.router.add_route("HEAD", "/{path:.*}", self.h_get)
         app.router.add_route("POST", "/{path:.*}", self.h_post)
@@ -91,8 +101,18 @@ class FilerServer:
     def url(self) -> str:
         return f"{self.ip}:{self.port}"
 
+    async def h_metrics(self, req: web.Request) -> web.Response:
+        from ..stats.metrics import metrics_text
+        return web.Response(body=metrics_text(),
+                            content_type="text/plain")
+
     async def start(self) -> None:
-        self.client = WeedClient(self.master_url)
+        cc = None
+        if self.cache_mem_bytes > 0:
+            from ..util.chunk_cache import TieredChunkCache
+            cc = TieredChunkCache(self.cache_mem_bytes,
+                                  disk_dir=self.cache_dir or None)
+        self.client = WeedClient(self.master_url, chunk_cache=cc)
         await self.client.__aenter__()
         # watch-fed location map: hot-path reads never lookup the master
         # (reference filer embeds wdclient the same way)

@@ -492,12 +492,17 @@ class VolumeServer:
                 tls.url(others[0]['publicUrl'], f"/{req.match_info['fid']}"))
         from ..stats import metrics
         try:
-            # disk (and possibly remote-shard) I/O: keep off the event loop
             loop = asyncio.get_running_loop()
             t0 = time.perf_counter()
-            n = await loop.run_in_executor(
-                None, lambda: self.store.read_needle(
-                    fid.volume_id, fid.key, fid.cookie))
+            # hot-needle cache peek: a hit answers on the event loop;
+            # misses pay the executor round-trip for disk (and possibly
+            # remote-shard) I/O
+            n = self.store.cached_needle(fid.volume_id, fid.key,
+                                         fid.cookie)
+            if n is None:
+                n = await loop.run_in_executor(
+                    None, lambda: self.store.read_needle(
+                        fid.volume_id, fid.key, fid.cookie))
             if metrics.HAVE_PROMETHEUS:
                 metrics.VOLUME_REQUEST_TIME.labels("read").observe(
                     time.perf_counter() - t0)
@@ -1161,6 +1166,14 @@ class VolumeServer:
               for vid, ev in self.store.ec_volumes.items()}
         out = {"version": "seaweedfs_tpu 0.1", "volumes": vols,
                "ecVolumes": ec}
+        caches = {}
+        if self.store.needle_cache is not None:
+            caches["needle"] = self.store.needle_cache.to_dict()
+        if self.store.ec_recover_cache is not None:
+            caches["ec_recover"] = \
+                self.store.ec_recover_cache.counters.to_dict()
+        if caches:
+            out["caches"] = caches
         wc = self.worker_ctx
         if wc is not None and not self._is_worker_hop(req):
             # whole-host view: fold in every sibling's partition
@@ -1375,8 +1388,13 @@ class VolumeServer:
         dec = vb.FrameDecoder()
 
         def apply_batch(recs) -> int:
+            nc = self.store.needle_cache
             for n, is_delete in recs:
                 vb.apply_needle(v, n, is_delete)
+                if nc is not None:
+                    # tail apply bypasses store.write/delete: each
+                    # replayed record must still invalidate its entry
+                    nc.invalidate(vid, n.id)
             return len(recs)
 
         try:
@@ -1463,7 +1481,10 @@ class VolumeServer:
             return web.json_response({"error": "not found"}, status=404)
         loop = asyncio.get_running_loop()
         try:
-            await loop.run_in_executor(None, lambda: vacuum.commit_compact(v))
+            # store-level commit: swaps .dat/.idx AND drops this
+            # volume's hot-needle cache entries (offsets all moved)
+            await loop.run_in_executor(
+                None, lambda: self.store.commit_compaction(vid))
         except vacuum.VacuumError as e:
             return web.json_response({"error": str(e)}, status=500)
         return web.json_response({"ok": True})

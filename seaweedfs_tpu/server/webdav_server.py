@@ -44,7 +44,9 @@ class WebDavServer:
                  ip: str = "127.0.0.1", port: int = 7333,
                  collection: str = "", replication: str = "",
                  chunk_size: int = 16 * 1024 * 1024,
-                 jwt_key: str = ""):
+                 jwt_key: str = "",
+                 cache_mem_bytes: int = 0,
+                 cache_dir: str = ""):
         self.filer = filer
         self.master_url = master_url
         self.ip = ip
@@ -52,7 +54,14 @@ class WebDavServer:
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
-        self.client = WeedClient(master_url, jwt_key=jwt_key)
+        cc = None
+        if cache_mem_bytes > 0:
+            # -cache.mem/-cache.dir chunk read cache (see FilerServer)
+            from ..util.chunk_cache import TieredChunkCache
+            cc = TieredChunkCache(cache_mem_bytes,
+                                  disk_dir=cache_dir or None)
+        self.client = WeedClient(master_url, jwt_key=jwt_key,
+                                 chunk_cache=cc)
         self._locks: dict[str, str] = {}  # path -> token (advisory)
         self._runner: web.AppRunner | None = None
         self._tasks: list[asyncio.Task] = []

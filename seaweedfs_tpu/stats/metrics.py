@@ -45,6 +45,25 @@ if HAVE_PROMETHEUS:
     EC_THROUGHPUT = Gauge(
         "SeaweedFS_ec_encode_GBps", "last measured EC encode GB/s/chip",
         registry=REGISTRY)
+    # tiered read caches (util/chunk_cache.py): one label per cache —
+    # "needle" (volume hot needles), "chunk" (filer/s3/webdav whole
+    # chunks), "ec_recover" (degraded-read reconstructions),
+    # "lookup_neg" (client negative volume lookups)
+    CACHE_HITS = Counter(
+        "SeaweedFS_cache_hits_total", "read-cache hits",
+        ["cache"], registry=REGISTRY)
+    CACHE_MISSES = Counter(
+        "SeaweedFS_cache_misses_total", "read-cache misses",
+        ["cache"], registry=REGISTRY)
+    CACHE_HIT_BYTES = Counter(
+        "SeaweedFS_cache_hit_bytes_total", "bytes served from read caches",
+        ["cache"], registry=REGISTRY)
+    CACHE_EVICTIONS = Counter(
+        "SeaweedFS_cache_evictions_total", "read-cache evictions",
+        ["cache"], registry=REGISTRY)
+    CACHE_USED_BYTES = Gauge(
+        "SeaweedFS_cache_used_bytes", "bytes currently held per cache",
+        ["cache"], registry=REGISTRY)
 
     def metrics_text() -> bytes:
         return generate_latest(REGISTRY)

@@ -36,9 +36,27 @@ class Store:
                  ec_small_block: int = SMALL_BLOCK_SIZE,
                  compaction_bytes_per_second: int = 0,
                  index_type: str = "auto",
-                 partition: "tuple[int, int] | None" = None):
+                 partition: "tuple[int, int] | None" = None,
+                 needle_cache_bytes: int = 0):
         # needle map kind for every owned volume (-index flag analog)
         self.index_type = index_type
+        # hot-needle read cache (-cache.mem flag): parsed needles keyed
+        # (vid, nid) under one byte budget, consulted by BOTH http paths
+        # through read_needle/cached_needle; 0 disables every volume-side
+        # read cache (needle + EC reconstruction)
+        from ..util.chunk_cache import EcRecoverCache, NeedleCache
+        # the configured budget is the TOTAL: hot needles get three
+        # quarters, the EC reconstruction cache the remaining quarter —
+        # an operator sizing -cache.mem must not find the process using
+        # more than the flag says
+        self.needle_cache = (NeedleCache(needle_cache_bytes * 3 // 4)
+                             if needle_cache_bytes > 0 else None)
+        # degraded-read reconstruction cache, shared across this store's
+        # EC volumes (keys carry the vid): repeated reads of a lost
+        # shard's hot intervals reuse the decoded bytes instead of
+        # re-running the GF(256) transform
+        self.ec_recover_cache = (EcRecoverCache(needle_cache_bytes // 4)
+                                 if needle_cache_bytes > 0 else None)
         # (index, total) under -workers N: this store owns only volumes
         # with vid % total == index — workers sharing the data dirs open
         # disjoint volume sets, so needle maps and file handles stay
@@ -128,7 +146,8 @@ class Store:
         ev = EcVolume(d, collection, vid,
                       large_block=self.ec_large_block,
                       small_block=self.ec_small_block,
-                      fetch_remote=self._make_remote_fetcher(vid))
+                      fetch_remote=self._make_remote_fetcher(vid),
+                      recover_cache=self.ec_recover_cache)
         self.ec_volumes[vid] = ev
         return ev
 
@@ -165,6 +184,7 @@ class Store:
             return v
 
     def delete_volume(self, vid: int, collection: str = "") -> None:
+        self.drop_cached_volume(vid)
         with self._lock:
             v = self.volumes.pop(vid, None)
             if v is not None:
@@ -204,6 +224,7 @@ class Store:
 
     def mount_volume(self, collection: str, vid: int) -> None:
         """Load an on-disk volume (after a copy) — VolumeMount."""
+        self.drop_cached_volume(vid)    # copied-in bytes may differ
         with self._lock:
             if vid in self.volumes:
                 return
@@ -223,6 +244,7 @@ class Store:
             raise VolumeError(f"volume {vid} not on disk")
 
     def unmount_volume(self, vid: int) -> None:
+        self.drop_cached_volume(vid)
         with self._lock:
             v = self.volumes.pop(vid, None)
             if v is not None:
@@ -240,15 +262,66 @@ class Store:
         v = self.volumes.get(vid)
         if v is None:
             raise NotFound(f"volume {vid} not found")
-        return v.write_needle(n)
+        result = v.write_needle(n)
+        # AFTER the durable append: dropping first would let a racing
+        # reader re-populate the old bytes between drop and write
+        if self.needle_cache is not None:
+            self.needle_cache.invalidate(vid, n.id)
+        return result
+
+    def _cached(self, vid: int, needle_id: int, cookie: int | None,
+                count_miss: bool = True,
+                count_hit: bool = True) -> Needle | None:
+        """Cache peek; None means the slow path must decide (miss,
+        cookie mismatch, expiry — the disk read raises the right
+        error for the last two). A hit is counted only AFTER the
+        cookie/expiry checks pass, so unservable entries don't inflate
+        the hit rate; the miss is counted once, by the slow path."""
+        nc = self.needle_cache
+        if nc is None:
+            return None
+        n = nc.peek(vid, needle_id)
+        if n is None or (cookie is not None and n.cookie != cookie) \
+                or n.has_expired():
+            if count_miss:
+                nc.miss()
+            return None
+        if count_hit:
+            nc.hit(n)
+        return n
+
+    def cached_needle(self, vid: int, needle_id: int,
+                      cookie: int | None = None,
+                      count: bool = True) -> Needle | None:
+        """Synchronous hot-path peek for the event-loop read handlers:
+        a hit answers without the executor round-trip or any disk I/O.
+        Declines (None) whenever the `store.read` chaos site is armed so
+        injected read faults keep firing under cache-hot load.
+        ``count=False`` defers all accounting to the caller — for the
+        fasthttp path, whose replay-to-aiohttp branch would otherwise
+        count the same client request twice."""
+        if failpoints.pending("store.read"):
+            return None
+        # the slow path that follows a peek miss counts it; counting
+        # here too would double every cold read's miss
+        return self._cached(vid, needle_id, cookie, count_miss=False,
+                            count_hit=count)
 
     def read_needle(self, vid: int, needle_id: int,
                     cookie: int | None = None) -> Needle:
         failpoints.sync_fail("store.read")  # chaos site (see store.write)
+        n = self._cached(vid, needle_id, cookie)
+        if n is not None:
+            return n
+        # snapshot the volume's mutation generation BEFORE the disk
+        # read: a write/delete landing between our read and our put
+        # bumps it, and put() then refuses the stale fill
+        nc = self.needle_cache
+        gen = nc.generation(vid) if nc is not None else 0
         v = self.volumes.get(vid)
         if v is not None:
             try:
-                return v.read_needle(needle_id, cookie)
+                n = v.read_needle(needle_id, cookie)
             except OSError:
                 if vid not in self.volumes:
                     # the volume was destroyed mid-read (TTL
@@ -256,30 +329,71 @@ class Store:
                     # bad-file-descriptor 500
                     raise NotFound(f"volume {vid} was removed")
                 raise
+            if nc is not None:
+                nc.put(vid, needle_id, n, gen=gen)
+            return n
         ev = self.ec_volumes.get(vid)
         if ev is not None:
             try:
-                return ev.read_needle(needle_id, cookie)
+                n = ev.read_needle(needle_id, cookie)
             except EcNotFound as e:
                 raise NotFound(str(e))
+            if nc is not None:
+                nc.put(vid, needle_id, n, gen=gen)
+            return n
         raise NotFound(f"volume {vid} not found")
 
     def delete_needle(self, vid: int, n: Needle) -> int:
         v = self.volumes.get(vid)
         if v is not None:
-            return v.delete_needle(n)
+            size = v.delete_needle(n)
+            if self.needle_cache is not None:
+                self.needle_cache.invalidate(vid, n.id)
+            return size
         ev = self.ec_volumes.get(vid)
         if ev is not None:
             ev.delete_needle(n.id)
+            if self.needle_cache is not None:
+                self.needle_cache.invalidate(vid, n.id)
             return 0
         raise NotFound(f"volume {vid} not found")
+
+    def drop_cached_volume(self, vid: int) -> None:
+        """Volume-wide cache invalidation: vacuum commit, tail-receive
+        apply, unmount/delete — any event that may rewrite needles
+        without going through write_needle/delete_needle."""
+        if self.needle_cache is not None:
+            self.needle_cache.drop_volume(vid)
+
+    def commit_compaction(self, vid: int) -> None:
+        """Vacuum commit + strict cache invalidation: the .dat/.idx
+        swap moves every surviving needle, so all cached entries for
+        the volume are dropped (a cached needle MUST miss after the
+        volume is vacuumed)."""
+        from . import vacuum
+        v = self.volumes.get(vid)
+        if v is None:
+            raise NotFound(f"volume {vid} not found")
+        vacuum.commit_compact(v)
+        self.drop_cached_volume(vid)
 
     def has_volume(self, vid: int) -> bool:
         return vid in self.volumes or vid in self.ec_volumes
 
     # ---- EC shard lifecycle (server side of ec.encode/rebuild) ----
 
+    def _drop_ec_recover(self, vid: int) -> None:
+        """The reconstruction cache is store-wide, so entries outlive
+        any one EcVolume object: a re-encoded volume remounted at the
+        same vid must not serve the old generation's decoded bytes.
+        drop_volume also bumps the vid's generation, which fences any
+        reconstruction fill still in flight against the old shards."""
+        if self.ec_recover_cache is not None:
+            self.ec_recover_cache.drop_volume(vid)
+
     def mount_ec_shards(self, collection: str, vid: int) -> list[int]:
+        self._drop_ec_recover(vid)
+        self.drop_cached_volume(vid)
         with self._lock:
             if not self.owns(vid):
                 raise VolumeError(
@@ -304,6 +418,8 @@ class Store:
 
     def unmount_ec_shards(self, vid: int, shard_ids: list[int] | None = None
                           ) -> None:
+        self._drop_ec_recover(vid)
+        self.drop_cached_volume(vid)
         with self._lock:
             ev = self.ec_volumes.get(vid)
             if ev is None:
@@ -381,6 +497,7 @@ class Store:
                 v = self.volumes.pop(vid)
                 self.deleted_volumes.append(self._volume_message(v))
                 v.destroy()
+                self.drop_cached_volume(vid)
             volumes = [self._volume_message(v) for v in active.values()]
             ec_msgs = []
             for vid, ev in self.ec_volumes.items():

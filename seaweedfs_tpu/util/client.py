@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from ..security import tls
 from .resilience import BreakerRegistry, RetryBudget, RetryPolicy
+from .singleflight import SingleFlight
 
 import asyncio
 import time
@@ -54,7 +55,9 @@ class WeedClient:
                  lookup_cache_ttl: float = 600.0,
                  jwt_key: str = "",
                  retry: RetryPolicy | None = None,
-                 breakers: BreakerRegistry | None = None):
+                 breakers: BreakerRegistry | None = None,
+                 chunk_cache=None,
+                 negative_lookup_ttl: float = 1.0):
         # comma-separated seed list: like the reference's wdclient, a
         # dead master must not strand the client — master requests
         # rotate through the surviving seeds (masterclient.go:45-119)
@@ -77,6 +80,22 @@ class WeedClient:
                                           budget=self.budget)
         self.breakers = breakers or BreakerRegistry(
             threshold=5, reset_timeout=5.0)
+        # optional whole-chunk read cache (util/chunk_cache
+        # TieredChunkCache): hot re-reads skip the volume-server hop;
+        # upload/delete of a fid drop its entry so read-your-writes
+        # holds through this client
+        self.chunk_cache = chunk_cache
+        # singleflight collapses concurrent duplicate work: one master
+        # lookup per vid round, one chunk fetch per fid round
+        self._lookup_sf = SingleFlight()
+        self._chunk_sf = SingleFlight()
+        # short-TTL negative lookup cache: a deleted/unknown volume
+        # answers from memory instead of hammering the master on every
+        # read; invalidated by assign (the vid may have just been grown)
+        self.negative_lookup_ttl = negative_lookup_ttl
+        self._neg_vids: dict[str, float] = {}
+        from .chunk_cache import CacheCounters
+        self._neg_counters = CacheCounters("lookup_neg")
 
     async def __aenter__(self) -> "WeedClient":
         if self._session is None:
@@ -109,6 +128,11 @@ class WeedClient:
         body = await self._master_get("/dir/assign", params)
         if "error" in body:
             raise OperationError(f"assign: {body['error']}")
+        fid = body.get("fid", "")
+        if fid:
+            # the assign may have just grown this volume: a lingering
+            # negative lookup entry would 404 the immediate read-back
+            self._neg_vids.pop(fid.split(",")[0], None)
         return body
 
     async def _master_get(self, path: str, params: dict) -> dict:
@@ -163,7 +187,11 @@ class WeedClient:
         self._master_client = mc
 
     async def lookup(self, vid: str) -> list[dict]:
-        """Volume locations with a TTL cache (lookup.go:10min)."""
+        """Volume locations with a TTL cache (lookup.go:10min), a
+        short-TTL negative cache, and singleflight: N concurrent misses
+        for one vid cost one master round trip, and reads of a
+        deleted/unknown volume stop hammering the master for the
+        negative TTL."""
         mc = getattr(self, "_master_client", None)
         if mc is not None:
             try:
@@ -178,14 +206,40 @@ class WeedClient:
         now = time.time()
         if hit and now - hit[0] < self._cache_ttl:
             return hit[1]
+        neg_until = self._neg_vids.get(vid)
+        if neg_until is not None:
+            if now < neg_until:
+                self._neg_counters.hit(0)
+                raise OperationError(
+                    f"lookup {vid}: volume not found (negative-cached)")
+            self._neg_vids.pop(vid, None)
+        return await self._lookup_sf.do(vid,
+                                        lambda: self._lookup_master(vid))
+
+    async def _lookup_master(self, vid: str) -> list[dict]:
+        self._neg_counters.miss()
         body = await self._master_get("/dir/lookup", {"volumeId": vid})
         if "locations" not in body:
+            # authoritative miss from a reachable master: negative-cache
+            # it (transport failures raise in _master_get and are NOT
+            # cached — the volume may be perfectly fine). Bounded: a
+            # client probing many distinct dead vids must not grow the
+            # dict forever — sweep expired entries, then oldest.
+            if len(self._neg_vids) >= 1024:
+                now = time.time()
+                self._neg_vids = {k: t for k, t in self._neg_vids.items()
+                                  if t > now}
+                while len(self._neg_vids) >= 1024:
+                    self._neg_vids.pop(next(iter(self._neg_vids)))
+            self._neg_vids[vid] = time.time() + self.negative_lookup_ttl
             raise OperationError(f"lookup {vid}: {body.get('error')}")
-        self._vid_cache[vid] = (now, body["locations"])
+        self._vid_cache[vid] = (time.time(), body["locations"])
+        self._neg_vids.pop(vid, None)
         return body["locations"]
 
     def invalidate(self, vid: str) -> None:
         self._vid_cache.pop(vid, None)
+        self._neg_vids.pop(vid, None)
 
     async def lookup_file_id(self, fid: str) -> str:
         vid = fid.split(",")[0]
@@ -212,6 +266,13 @@ class WeedClient:
         token = auth or self._mint_jwt(fid)
         if token:
             headers["Authorization"] = f"Bearer {token}"
+        if self.chunk_cache is not None:
+            # same-fid overwrite: drop BEFORE the write so reads issued
+            # from now on can't hit the old bytes. A second drop AFTER
+            # the write succeeds (below) closes the other window — a
+            # fetch that started during the POST's round trip read the
+            # old body from the server and would otherwise re-pin it.
+            self.chunk_cache.delete(fid)
         br = self.breakers.get(url)
         last: object = None
         async for _ in self.retry.attempts():
@@ -226,6 +287,8 @@ class WeedClient:
                     body = await resp.json()
                     if resp.status in (200, 201):
                         br.record_success()
+                        if self.chunk_cache is not None:
+                            self.chunk_cache.delete(fid)
                         return body
                     if resp.status < 500:
                         br.record_success()   # server healthy, we erred
@@ -283,6 +346,68 @@ class WeedClient:
 
     async def read_stream(self, fid: str, offset: int = 0,
                           size: int = -1):
+        """Cached-or-network chunk stream: when a chunk cache is
+        attached and holds this fid's whole body, the requested range
+        is sliced from memory and the volume-server hop is skipped
+        entirely; otherwise the degraded-read network path below runs
+        unchanged."""
+        cc = self.chunk_cache
+        if cc is not None:
+            data = await self._cc_get(fid)
+            if data is not None:
+                end = len(data) if size < 0 else min(len(data),
+                                                     offset + size)
+                for pos in range(offset, end, 1 << 16):
+                    yield data[pos:min(pos + (1 << 16), end)]
+                return
+        async for chunk in self._read_stream_net(fid, offset, size):
+            yield chunk
+
+    async def _cc_get(self, fid: str) -> bytes | None:
+        """Chunk-cache lookup that keeps mmap disk-tier I/O off the
+        event loop — a cold-page 4MB slice would otherwise block every
+        request on the daemon behind its page faults."""
+        cc = self.chunk_cache
+        if cc.has_disk:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, cc.get, fid)
+        return cc.get(fid)
+
+    async def chunk_bytes(self, fid: str, size: int = -1) -> bytes:
+        """Whole-chunk read through the cache, with singleflight: N
+        concurrent readers of one cold chunk trigger ONE volume-server
+        fetch; everyone shares the bytes (filer/S3/WebDAV hot path)."""
+        cc = self.chunk_cache
+        if cc is None:
+            return await self.read(fid, 0, size)
+        data = await self._cc_get(fid)
+        if data is not None:
+            return data
+        # token BEFORE the fetch, and IN the singleflight key: a fill
+        # that raced an overwrite/delete is refused by set_if, and a
+        # reader arriving AFTER an acknowledged write starts a fresh
+        # round instead of joining the stale in-flight one (the old
+        # round only serves callers that began before the write
+        # completed — a legal serialization)
+        token = cc.fill_token(fid)
+
+        async def fetch() -> bytes:
+            parts = []
+            async for chunk in self._read_stream_net(fid, 0, size):
+                parts.append(chunk)
+            blob = b"".join(parts)
+            if cc.has_disk:
+                # mmap writes for disk-routed sizes: executor, not loop
+                await asyncio.get_running_loop().run_in_executor(
+                    None, cc.set_if, fid, blob, token)
+            else:
+                cc.set_if(fid, blob, token)
+            return blob
+
+        return await self._chunk_sf.do((fid, token), fetch)
+
+    async def _read_stream_net(self, fid: str, offset: int = 0,
+                               size: int = -1):
         """Async-generate the bytes of a needle with DEGRADED-READ
         FAILOVER: every holder from the lookup is tried; a holder that
         dies MID-BODY does not fail the read — the stream rotates to
@@ -379,7 +504,11 @@ class WeedClient:
 
     async def read(self, fid: str, offset: int = 0,
                    size: int = -1) -> bytes:
-        """Read with location failover (buffered form of read_stream)."""
+        """Read with location failover (buffered form of read_stream).
+        Whole-needle reads route through the chunk cache + singleflight
+        when one is attached."""
+        if self.chunk_cache is not None and offset == 0 and size < 0:
+            return await self.chunk_bytes(fid)
         parts = []
         async for chunk in self.read_stream(fid, offset, size):
             parts.append(chunk)
@@ -390,6 +519,8 @@ class WeedClient:
         (delete_content.go DeleteFilesAtOneVolumeServer)."""
         by_server: dict[str, list[str]] = {}
         for fid in fids:
+            if self.chunk_cache is not None:
+                self.chunk_cache.delete(fid)
             try:
                 locs = await self.lookup(fid.split(",")[0])
             except OperationError:
@@ -454,4 +585,10 @@ class WeedClient:
 
         counts = await asyncio.gather(
             *(drop(s, b) for s, b in by_server.items()))
+        if self.chunk_cache is not None:
+            # second drop AFTER the tombstones landed: a fetch that
+            # raced the deletes read the still-live body and would
+            # otherwise re-pin a "deleted" chunk (see upload())
+            for fid in fids:
+                self.chunk_cache.delete(fid)
         return sum(counts)

@@ -1,0 +1,28 @@
+"""Cache-churn smoke (marked slow — excluded from tier-1): a short
+tools/soak.py cache-churn run against a real -workers 2 cluster with
+the hot-needle + chunk caches on and failpoints armed. Every read is
+byte-verified; any stale read (old bytes after an overwrite, success
+after a delete) fails the soak, so cache-invalidation regressions are
+caught by the suite."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_cache_churn_quick(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               SWTPU_CHURN_SECONDS="8", SWTPU_CHURN_FILES="120")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "soak.py"),
+         "cache-churn"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+    sys.stdout.write(out.stdout)
+    sys.stderr.write(out.stderr)
+    assert out.returncode == 0, "cache churn soak reported stale/lost reads"
+    assert "stale" in out.stdout        # the verifier actually ran

