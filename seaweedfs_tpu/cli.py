@@ -30,9 +30,29 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="also log to stderr (set false with -logdir for "
                         "file-only logging)")
     p.add_argument("-cpuprofile", default="",
-                   help="write cProfile stats here on exit")
+                   help="write cProfile stats here on exit (under "
+                        "-workers N each worker writes <path>.w<index> "
+                        "so the dumps don't clobber each other)")
     p.add_argument("-memprofile", default="",
-                   help="write tracemalloc top allocations here on exit")
+                   help="write tracemalloc top allocations here on exit "
+                        "(suffixed .w<index> under -workers, like "
+                        "-cpuprofile)")
+    p.add_argument("-trace.sample", dest="trace_sample", type=float,
+                   default=1.0,
+                   help="distributed-tracing sample rate for requests "
+                        "arriving WITHOUT a traceparent header (0 = "
+                        "start no traces here; requests carrying an "
+                        "upstream sampled traceparent are still joined "
+                        "and recorded — set 0 fleet-wide to silence "
+                        "tracing end to end)")
+    p.add_argument("-trace.slowms", dest="trace_slowms", type=float,
+                   default=0.0,
+                   help="glog WARNING (with the trace id) for any entry "
+                        "span slower than this many ms; 0 disables")
+    p.add_argument("-trace.ring", dest="trace_ring", type=int,
+                   default=2048,
+                   help="finished spans kept in the per-process "
+                        "/debug/traces ring buffer")
 
 
 def _add_workers(p: argparse.ArgumentParser) -> None:
@@ -1520,9 +1540,15 @@ def main(argv: list[str] | None = None) -> None:
         glog.init(verbosity=args.verbosity,
                   log_dir=args.logdir or None,
                   logtostderr=args.logtostderr)
+        from .util import tracing
+        tracing.init(sample=args.trace_sample, slow_ms=args.trace_slowms,
+                     ring=args.trace_ring)
         if args.cpuprofile or args.memprofile:
             from .util.pprof import setup_profiling
-            setup_profiling(args.cpuprofile, args.memprofile)
+            # -workers N: each worker suffixes the dump path with its
+            # index, or all N processes would clobber one file
+            setup_profiling(args.cpuprofile, args.memprofile,
+                            worker_index=getattr(args, "workerIndex", -1))
         if os.environ.get("WEED_FAILPOINTS"):
             # armed at import by util/failpoints; an injected-fault run
             # must never be mistakable for a healthy one in the logs

@@ -149,6 +149,7 @@ class EcVolume:
         needle reuse the decoded bytes instead of re-gathering ten
         shards and re-running the GF(256) transform (the dominant
         degraded-read cost — arxiv 2306.10528)."""
+        from ..util import tracing
         rc = self._recover_cache
         key = (self.vid, want_sid, offset, size)
         gen = None
@@ -162,36 +163,44 @@ class EcVolume:
             # the stale fill below is refused
             if hasattr(rc, "generation"):
                 gen = rc.generation(self.vid)
-        bufs: list[np.ndarray] = []
-        rows: list[int] = []
-        for sid in range(gf.TOTAL_SHARDS):
-            if sid == want_sid or len(rows) == gf.DATA_SHARDS:
-                continue
-            data: bytes | None = None
-            f = self.shards.get(sid)
-            if f is not None:
-                raw = os.pread(f.fileno(), size, offset)
-                data = raw + b"\x00" * (size - len(raw))
-            elif self.fetch_remote is not None:
-                data = self.fetch_remote(sid, offset, size)
-            if data is not None:
-                rows.append(sid)
-                bufs.append(np.frombuffer(data, np.uint8))
-        if len(rows) < gf.DATA_SHARDS:
-            raise EcVolumeError(
-                f"cannot recover shard {want_sid}: only {len(rows)} "
-                f"sources available")
-        glog.V(3).infof("ec recover vid=%d shard=%d off=%d size=%d from %s",
-                        self.vid, want_sid, offset, size, rows)
-        coeff = gf.shard_rows([want_sid], rows)
-        out = _transform_buffers(self.encoder(size), coeff, bufs)
-        data = np.asarray(out[0], np.uint8).tobytes()
-        if rc is not None:
-            if gen is not None:
-                rc.put_fenced(key, data, gen)
-            else:
-                rc.put(key, data)
-        return data
+        # traced as its own span: the GF(256) gather+decode is the
+        # dominant degraded-read cost (arxiv 2306.10528) and must be
+        # attributable per request, not only in aggregate
+        with tracing.start("ec", "recover", vid=self.vid,
+                           shard=want_sid) as sp:
+            bufs: list[np.ndarray] = []
+            rows: list[int] = []
+            for sid in range(gf.TOTAL_SHARDS):
+                if sid == want_sid or len(rows) == gf.DATA_SHARDS:
+                    continue
+                data: bytes | None = None
+                f = self.shards.get(sid)
+                if f is not None:
+                    raw = os.pread(f.fileno(), size, offset)
+                    data = raw + b"\x00" * (size - len(raw))
+                elif self.fetch_remote is not None:
+                    data = self.fetch_remote(sid, offset, size)
+                if data is not None:
+                    rows.append(sid)
+                    bufs.append(np.frombuffer(data, np.uint8))
+            sp.set("shards", list(rows))
+            if len(rows) < gf.DATA_SHARDS:
+                raise EcVolumeError(
+                    f"cannot recover shard {want_sid}: only {len(rows)} "
+                    f"sources available")
+            glog.V(3).infof(
+                "ec recover vid=%d shard=%d off=%d size=%d from %s",
+                self.vid, want_sid, offset, size, rows)
+            coeff = gf.shard_rows([want_sid], rows)
+            out = _transform_buffers(self.encoder(size), coeff, bufs)
+            data = np.asarray(out[0], np.uint8).tobytes()
+            sp.nbytes = len(data)
+            if rc is not None:
+                if gen is not None:
+                    rc.put_fenced(key, data, gen)
+                else:
+                    rc.put(key, data)
+            return data
 
     def verify_parity(self, window_size: int = 4 << 20) -> dict:
         """Scrub: recompute RS(10,4) parity over every stripe window and

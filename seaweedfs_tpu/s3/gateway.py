@@ -89,8 +89,16 @@ class S3Gateway:
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
+        from ..util import tracing
         app = web.Application(client_max_size=5 * 1024 * 1024 * 1024,
                               middlewares=[self._auth_middleware])
+        # reserved introspection paths FIRST (route order wins): the
+        # trace ring of this gateway process, mirroring the volume
+        # server's /debug/traces (documented caveat: shadows a bucket
+        # literally named __debug__); shared handlers, no drift
+        h_traces, h_requests = tracing.debug_handlers()
+        app.router.add_get("/__debug__/traces", h_traces)
+        app.router.add_get("/__debug__/requests", h_requests)
         # "*": with -domainName, PUT/DELETE bucket.domain/ are bucket
         # operations that land on the root path
         app.router.add_route("*", "/", self.h_list_buckets)
@@ -100,6 +108,7 @@ class S3Gateway:
 
     @web.middleware
     async def _auth_middleware(self, req: web.Request, handler):
+        from ..util import tracing
         if self.identities:
             try:
                 # raw_path: SigV4 signs the encoded form verbatim, and a
@@ -110,11 +119,21 @@ class S3Gateway:
                     list(req.query.items()), req.headers, None)
             except AuthError as e:
                 return _err(e.code, str(e), _auth_status(e))
-        try:
-            return await handler(req)
-        except AuthError as e:
-            # mid-stream chunk-signature / truncation failures
-            return _err(e.code, str(e), _auth_status(e))
+        sp = (tracing._NOOP if req.path.startswith("/__debug__")
+              else tracing.start_root(
+                  "s3", req.method.lower(), headers=req.headers))
+        with sp:
+            try:
+                resp = await handler(req)
+            except AuthError as e:
+                # mid-stream chunk-signature / truncation failures
+                sp.status = "auth"
+                return _err(e.code, str(e), _auth_status(e))
+            except web.HTTPException as e:
+                sp.status = str(e.status)
+                raise
+            sp.status = "ok" if resp.status < 400 else str(resp.status)
+            return resp
 
     @property
     def url(self) -> str:
@@ -562,14 +581,23 @@ class S3Gateway:
         resp = web.StreamResponse(status=status, headers=headers)
         resp.content_type = ct
         await resp.prepare(req)
-        try:
-            async for data in stream_chunk_views(self.client, entry.chunks,
-                                                 offset, length):
-                await resp.write(data)
-        except OperationError:
-            if req.transport is not None:
-                req.transport.close()
-            return resp
+        # filer-tier stream span: the chunk fan-out/assembly cost of
+        # this object read, with the volume hops as client children
+        from ..util import tracing
+        with tracing.start("filer", "stream",
+                           chunks=len(entry.chunks)) as sp:
+            try:
+                sent = 0
+                async for data in stream_chunk_views(
+                        self.client, entry.chunks, offset, length):
+                    await resp.write(data)
+                    sent += len(data)
+                sp.nbytes = sent
+            except OperationError:
+                sp.status = "error"
+                if req.transport is not None:
+                    req.transport.close()
+                return resp
         await resp.write_eof()
         return resp
 

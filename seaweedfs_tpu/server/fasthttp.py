@@ -35,8 +35,12 @@ from ..storage.needle import (FLAG_HAS_LAST_MODIFIED, CrcMismatch, Needle,
                               NeedleError)
 from ..storage.volume import AlreadyDeleted, NotFound, VolumeError
 from ..ec.ec_volume import EcVolumeError
+from ..util import tracing
 from ..util.failpoints import (FailpointDrop, FailpointError,
                                pending as _fp_pending)
+
+# context-propagating executor hop (store spans parent correctly)
+_traced_executor = tracing.run_in_executor
 
 _REQ_LINE = re.compile(
     rb"^(GET|POST|PUT) /(\d+,[0-9a-fA-F]+)((?:\?[^ ]*)?) HTTP/1\.1$")
@@ -260,54 +264,73 @@ class FastNeedleProtocol(asyncio.Protocol):
                 return
             self._finish(_R404_VOL)
             return
-        # hot-needle cache peek first: a hit answers on the event loop
-        # with zero disk I/O and no executor round-trip — the dominant
-        # per-request cost left on this path (BENCH_NEEDLE.md).
-        # count=False: whether this lookup counts depends on what the
-        # needle turns out to be — a pairs/gzip/manifest needle replays
-        # through aiohttp, which does its own (single) accounting
-        n = vs.store.cached_needle(fid.volume_id, fid.key, fid.cookie,
-                                   count=False)
-        from_cache = n is not None
-        try:
-            if n is None:
-                n = await asyncio.get_running_loop().run_in_executor(
-                    None, vs.store.read_needle,
-                    fid.volume_id, fid.key, fid.cookie)
-        except (NotFound, AlreadyDeleted):
-            vs.count("read", "404")
-            self._finish(_R404)
-            return
-        except CrcMismatch as e:
-            self._finish(_json_err(500, "Internal Server Error", str(e)))
-            return
-        except (EcVolumeError, BackendError) as e:
-            vs.count("read", "error")
-            self._finish(_json_err(503, "Service Unavailable", str(e)))
-            return
-        except FailpointDrop:
-            # injected connection drop: sever, don't answer
-            self._closed = True
-            self._busy = False
-            self.transport.close()
-            return
-        except FailpointError as e:
-            self._finish(_json_err(e.status, "Injected Error", str(e)))
-            return
-        except Exception as e:  # noqa: BLE001 — keep the conn coherent
-            self._finish(_json_err(500, "Internal Server Error", str(e)))
-            return
-        if n.pairs or n.is_chunked_manifest or n.is_gzipped:
-            # pairs->headers / manifest assembly / gzip negotiation:
-            # re-serve this request through the full handler (which
-            # counts the cache hit/miss for this request itself)
-            self._upgrade_replay(b"GET", fid_s, headers)
-            return
-        if from_cache:
-            # deferred accounting for the served fast-path hit
-            vs.store.needle_cache.hit(n)
-        vs.count("read", "ok")
-        body = n.data
+        # volume-tier entry span for the fast path; a request that
+        # replays into aiohttp cancels it (the full handler's
+        # middleware records its own, joined to the same traceparent)
+        sp = tracing.start_root("volume", "read", headers=headers)
+        with sp:
+            # hot-needle cache peek first: a hit answers on the event
+            # loop with zero disk I/O and no executor round-trip — the
+            # dominant per-request cost left on this path
+            # (BENCH_NEEDLE.md). count=False: whether this lookup
+            # counts depends on what the needle turns out to be — a
+            # pairs/gzip/manifest needle replays through aiohttp,
+            # which does its own (single) accounting
+            n = vs.store.cached_needle(fid.volume_id, fid.key,
+                                       fid.cookie, count=False)
+            from_cache = n is not None
+            try:
+                if n is None:
+                    n = await _traced_executor(
+                        vs.store.read_needle,
+                        fid.volume_id, fid.key, fid.cookie)
+            except (NotFound, AlreadyDeleted):
+                vs.count("read", "404")
+                sp.status = "404"
+                self._finish(_R404)
+                return
+            except CrcMismatch as e:
+                sp.status = "500"
+                self._finish(_json_err(500, "Internal Server Error",
+                                       str(e)))
+                return
+            except (EcVolumeError, BackendError) as e:
+                vs.count("read", "error")
+                sp.status = "503"
+                self._finish(_json_err(503, "Service Unavailable",
+                                       str(e)))
+                return
+            except FailpointDrop:
+                # injected connection drop: sever, don't answer
+                sp.status = "drop"
+                self._closed = True
+                self._busy = False
+                self.transport.close()
+                return
+            except FailpointError as e:
+                sp.status = str(e.status)
+                self._finish(_json_err(e.status, "Injected Error",
+                                       str(e)))
+                return
+            except Exception as e:  # noqa: BLE001 — keep conn coherent
+                sp.status = "500"
+                self._finish(_json_err(500, "Internal Server Error",
+                                       str(e)))
+                return
+            if n.pairs or n.is_chunked_manifest or n.is_gzipped:
+                # pairs->headers / manifest assembly / gzip negotiation:
+                # re-serve this request through the full handler (which
+                # counts the cache hit/miss for this request itself)
+                sp.cancel()
+                self._upgrade_replay(b"GET", fid_s, headers)
+                return
+            if from_cache:
+                # deferred accounting for the served fast-path hit
+                vs.store.needle_cache.hit(n)
+                sp.set("source", "cache")
+            vs.count("read", "ok")
+            sp.nbytes = len(n.data)
+            body = n.data
         ct = n.mime.decode() if n.mime else "application/octet-stream"
         extra = b""
         if n.name:
@@ -383,29 +406,40 @@ class FastNeedleProtocol(asyncio.Protocol):
             self._finish(_json_err(400, "Bad Request", str(e)))
             return
         n.set_flag(FLAG_HAS_LAST_MODIFIED)
-        try:
-            _, size = await asyncio.get_running_loop().run_in_executor(
-                None, vs.store.write_needle, fid.volume_id, n)
-        except NotFound:
-            self._finish(_json_err(404, "Not Found", "volume not found"))
-            return
-        except NeedleError as e:
-            self._finish(_json_err(400, "Bad Request", str(e)))
-            return
-        except VolumeError as e:
-            self._finish(_json_err(409, "Conflict", str(e)))
-            return
-        except FailpointDrop:
-            self._closed = True
-            self._busy = False
-            self.transport.close()
-            return
-        except FailpointError as e:
-            self._finish(_json_err(e.status, "Injected Error", str(e)))
-            return
-        except Exception as e:  # noqa: BLE001
-            self._finish(_json_err(500, "Internal Server Error", str(e)))
-            return
+        with tracing.start_root("volume", "write", headers=headers) as sp:
+            try:
+                _, size = await _traced_executor(
+                    vs.store.write_needle, fid.volume_id, n)
+            except NotFound:
+                sp.status = "404"
+                self._finish(_json_err(404, "Not Found",
+                                       "volume not found"))
+                return
+            except NeedleError as e:
+                sp.status = "400"
+                self._finish(_json_err(400, "Bad Request", str(e)))
+                return
+            except VolumeError as e:
+                sp.status = "409"
+                self._finish(_json_err(409, "Conflict", str(e)))
+                return
+            except FailpointDrop:
+                sp.status = "drop"
+                self._closed = True
+                self._busy = False
+                self.transport.close()
+                return
+            except FailpointError as e:
+                sp.status = str(e.status)
+                self._finish(_json_err(e.status, "Injected Error",
+                                       str(e)))
+                return
+            except Exception as e:  # noqa: BLE001
+                sp.status = "500"
+                self._finish(_json_err(500, "Internal Server Error",
+                                       str(e)))
+                return
+            sp.nbytes = len(body)
         vs.count("write", "ok")
         rbody = (b"{\"name\": \"\", \"size\": " + str(size).encode()
                  + b", \"eTag\": \"" + n.etag().encode() + b"\"}")

@@ -58,16 +58,30 @@ class FilerServer:
 
     def _build_app(self) -> web.Application:
         from ..stats import metrics
+        from ..util import tracing
 
         @web.middleware
         async def timing(request, handler):
             t0 = time.perf_counter()
+            kind = "read" if request.method in ("GET", "HEAD") \
+                else "write"
+            # filer-tier entry span; the reserved introspection paths
+            # (/__metrics__, /__debug__/...) stay out of the ring
+            sp = (tracing._NOOP if request.path.startswith("/__")
+                  else tracing.start_root("filer", kind,
+                                          headers=request.headers))
             try:
-                return await handler(request)
+                with sp:
+                    try:
+                        resp = await handler(request)
+                    except web.HTTPException as e:
+                        sp.status = str(e.status)
+                        raise
+                    sp.status = ("ok" if resp.status < 400
+                                 else str(resp.status))
+                    return resp
             finally:
                 if metrics.HAVE_PROMETHEUS:
-                    kind = "read" if request.method in ("GET", "HEAD") \
-                        else "write"
                     metrics.FILER_REQUEST_TIME.labels(kind).observe(
                         time.perf_counter() - t0)
 
@@ -86,6 +100,12 @@ class FilerServer:
         from ..util import failpoints
         app.router.add_route("*", "/__debug__/failpoints",
                              failpoints.handle_debug)
+        # reserved-prefix twins of the volume server's /debug/traces//
+        # debug/requests (a stored file named /debug/traces must stay
+        # reachable); one shared implementation across filer/S3/WebDAV
+        h_traces, h_requests = tracing.debug_handlers()
+        app.router.add_get("/__debug__/traces", h_traces)
+        app.router.add_get("/__debug__/requests", h_requests)
         # reserved-prefix path (like /__api__, /__debug__) so a stored
         # file named /metrics is never shadowed; exposes the chunk-cache
         # hit/miss/byte counters among the rest of the registry
@@ -105,6 +125,7 @@ class FilerServer:
         from ..stats.metrics import metrics_text
         return web.Response(body=metrics_text(),
                             content_type="text/plain")
+
 
     async def start(self) -> None:
         cc = None
@@ -227,17 +248,27 @@ class FilerServer:
         resp = web.StreamResponse(status=status, headers=headers)
         resp.content_type = ct
         await resp.prepare(req)
-        # stream chunk views (filer2/stream.go StreamContent)
-        try:
-            async for data in stream_chunk_views(self.client, entry.chunks,
-                                                 offset, length):
-                await resp.write(data)
-        except OperationError:
-            # headers already sent: abort the connection so the client
-            # sees a transport error, not a silently short body
-            if req.transport is not None:
-                req.transport.close()
-            return resp
+        # stream chunk views (filer2/stream.go StreamContent) under a
+        # stream span: its SELF time is the filer's chunk fan-out +
+        # assembly cost, its client children are the volume-tier hops
+        from ..util import tracing
+        with tracing.start("filer", "stream",
+                           chunks=len(entry.chunks)) as sp:
+            try:
+                sent = 0
+                async for data in stream_chunk_views(
+                        self.client, entry.chunks, offset, length):
+                    await resp.write(data)
+                    sent += len(data)
+                sp.nbytes = sent
+            except OperationError:
+                # headers already sent: abort the connection so the
+                # client sees a transport error, not a silently short
+                # body
+                sp.status = "error"
+                if req.transport is not None:
+                    req.transport.close()
+                return resp
         await resp.write_eof()
         return resp
 

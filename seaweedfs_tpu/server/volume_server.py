@@ -25,7 +25,7 @@ from ..ec import gf
 from ..ec import pipeline as ecpl
 from ..ec.ec_volume import EcVolumeError
 from ..pb import messages as pb
-from ..util import failpoints, glog
+from ..util import failpoints, glog, tracing
 from ..util.resilience import BreakerRegistry
 from ..storage import types as t
 from ..storage.needle import (FLAG_GZIP, FLAG_HAS_LAST_MODIFIED,
@@ -155,6 +155,46 @@ class VolumeServer:
             return False
         return True
 
+    _TRACE_OPS = {"GET": "read", "HEAD": "read", "POST": "write",
+                  "PUT": "write", "DELETE": "delete"}
+
+    @web.middleware
+    async def _trace_mw(self, req: web.Request, handler):
+        """Volume-tier entry span for the aiohttp (cold) path — needle
+        requests and the /admin mesh, never the introspection surface
+        (/debug, /metrics, /status, ...). Outermost middleware, so the
+        guard and the sibling-proxy hop are both inside the span.
+        Only REGISTERED /admin routes derive an op label: the op feeds
+        prometheus label values, and a scanner probing /admin/<junk>
+        (this runs before the guard, and for 404s) must not mint
+        unbounded label children in the registry."""
+        p = req.path
+        if _FID_PATH.match(p):
+            op = self._TRACE_OPS.get(req.method, req.method.lower())
+        elif p in self._traced_admin:
+            op = p[len("/admin/"):].replace("/", ".")
+        else:
+            return await handler(req)
+        sp = tracing.start_root("volume", op, headers=req.headers)
+        if not sp:
+            return await handler(req)
+        with sp:
+            try:
+                resp = await handler(req)
+            except web.HTTPException as e:
+                sp.status = str(e.status)
+                raise
+            sp.status = "ok" if resp.status < 400 else str(resp.status)
+            if resp.content_length:
+                sp.nbytes = resp.content_length
+            return resp
+
+    async def _in_executor(self, fn, *args):
+        """Executor round-trip that carries the tracing context into
+        the worker thread, so store/EC spans parent under the request
+        span (tracing.run_in_executor)."""
+        return await tracing.run_in_executor(fn, *args)
+
     @web.middleware
     async def _worker_route_mw(self, req: web.Request, handler):
         """-workers partition routing: a request for a volume owned by
@@ -176,19 +216,30 @@ class VolumeServer:
                           f"volume {vid}) unavailable"}, status=503)
         br = self._sibling_breakers.get(target)
         if not br.allow():
+            sp = tracing.current()
+            sp.event("breaker_open", upstream=target)
             return web.json_response(
                 {"error": f"worker {wc.owner_index(vid)} (owner of "
                           f"volume {vid}) circuit open"}, status=503)
-        resp = await wk.proxy_request(req, self._http, target, wc.token)
-        if resp.status == 502:
-            br.record_failure()
-        else:
-            br.record_success()
-        return resp
+        # the cross-worker hop is its own span, and proxy_request stamps
+        # its traceparent on the forwarded request so the sibling's
+        # server span nests under it — one trace across both workers
+        with tracing.start("proxy", "sibling", target=target,
+                           worker=wc.owner_index(vid)) as sp:
+            resp = await wk.proxy_request(req, self._http, target,
+                                          wc.token)
+            if resp.status == 502:
+                br.record_failure()
+                sp.status = "502"
+            else:
+                br.record_success()
+                sp.status = "ok" if resp.status < 400 else str(resp.status)
+            return resp
 
     def _build_app(self) -> web.Application:
         from ..security.guard import middleware as guard_mw
-        middlewares = [guard_mw(lambda: self.guard,
+        middlewares = [self._trace_mw,
+                       guard_mw(lambda: self.guard,
                                 self._guarded_request)]
         if self.worker_ctx is not None:
             middlewares.append(self._worker_route_mw)
@@ -228,6 +279,8 @@ class VolumeServer:
         app.router.add_post("/admin/tier/download", self.h_tier_download)
         app.router.add_route("*", "/debug/failpoints", self.h_failpoints)
         app.router.add_get("/debug/breakers", self.h_breakers)
+        app.router.add_get("/debug/traces", self.h_traces)
+        app.router.add_get("/debug/requests", self.h_requests)
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_get("/stats/workers", self.h_stats_workers)
@@ -238,6 +291,11 @@ class VolumeServer:
         app.router.add_route("POST", "/{fid:[^/]+}", self.h_post)
         app.router.add_route("PUT", "/{fid:[^/]+}", self.h_post)
         app.router.add_route("DELETE", "/{fid:[^/]+}", self.h_delete)
+        # the registered admin routes are the ONLY paths the trace
+        # middleware will turn into an op label (bounded cardinality)
+        self._traced_admin = frozenset(
+            res.canonical for res in app.router.resources()
+            if res.canonical.startswith("/admin/"))
         return app
 
     @property
@@ -347,6 +405,12 @@ class VolumeServer:
         if shards is None:
             return None
         ctx = tls.client_ctx()
+        # runs inside the executor thread whose context the read path
+        # copied in, so the store span is current here — stamping the
+        # traceparent keeps the remote holder's shard_read span in THIS
+        # request's trace
+        trace_headers: dict = {}
+        tracing.inject(trace_headers)
         attempted = False
         for target in shards.get(str(shard_id), []):
             if target == self.url:
@@ -354,10 +418,12 @@ class VolumeServer:
             attempted = True
             try:
                 with urllib.request.urlopen(
-                        tls.url(target,
-                                f"/admin/ec/shard_read?volume={vid}"
-                                f"&shard={shard_id}&offset={offset}"
-                                f"&size={size}"),
+                        urllib.request.Request(
+                            tls.url(target,
+                                    f"/admin/ec/shard_read?volume={vid}"
+                                    f"&shard={shard_id}&offset={offset}"
+                                    f"&size={size}"),
+                            headers=trace_headers),
                         timeout=30, context=ctx) as r:
                     data = r.read()
                     if len(data) == size:
@@ -499,10 +565,12 @@ class VolumeServer:
             # remote-shard) I/O
             n = self.store.cached_needle(fid.volume_id, fid.key,
                                          fid.cookie)
-            if n is None:
-                n = await loop.run_in_executor(
-                    None, lambda: self.store.read_needle(
-                        fid.volume_id, fid.key, fid.cookie))
+            if n is not None:
+                tracing.current().set("source", "cache")
+            else:
+                n = await self._in_executor(
+                    self.store.read_needle,
+                    fid.volume_id, fid.key, fid.cookie)
             if metrics.HAVE_PROMETHEUS:
                 metrics.VOLUME_REQUEST_TIME.labels("read").observe(
                     time.perf_counter() - t0)
@@ -782,10 +850,9 @@ class VolumeServer:
             n = await self._needle_from_request(req, fid)
         from ..stats import metrics
         try:
-            loop = asyncio.get_running_loop()
             t0 = time.perf_counter()
-            _, size = await loop.run_in_executor(
-                None, lambda: self.store.write_needle(fid.volume_id, n))
+            _, size = await self._in_executor(
+                self.store.write_needle, fid.volume_id, n)
             if metrics.HAVE_PROMETHEUS:
                 metrics.VOLUME_REQUEST_TIME.labels("write").observe(
                     time.perf_counter() - t0)
@@ -1032,6 +1099,13 @@ class VolumeServer:
         targets = [l["url"] for l in locs if l["url"] != self.url]
 
         extra = {"Authorization": auth} if auth else {}
+        # the fan-out is one replicate-tier span; each replica hop is
+        # an event, and the forwarded traceparent makes every replica's
+        # own (volume, store) spans part of the same trace
+        rsp = tracing.start("replicate", "fanout", fid=fid,
+                            targets=len(targets))
+        if rsp:
+            tracing.inject(extra, rsp)
 
         async def one(target: str) -> bool:
             try:
@@ -1055,6 +1129,8 @@ class VolumeServer:
                             glog.warning(
                                 "replicate %s to %s: http %d", fid,
                                 target, r.status)
+                            rsp.event("replica_failed", target=target,
+                                      status=r.status)
                         return ok
                 async with self._http.delete(
                         tls.url(target, f"/{fid}"),
@@ -1063,10 +1139,16 @@ class VolumeServer:
                     return r.status == 200
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
                 glog.warning("replicate %s to %s: %s", fid, target, e)
+                rsp.event("replica_failed", target=target,
+                          error=f"{type(e).__name__} {e}"[:120])
                 return False
 
-        results = await asyncio.gather(*(one(x) for x in targets))
-        return all(results)
+        try:
+            results = await asyncio.gather(*(one(x) for x in targets))
+            rsp.status = "ok" if all(results) else "error"
+            return all(results)
+        finally:
+            rsp.finish()
 
     # ---- admin handlers ----
 
@@ -1148,6 +1230,49 @@ class VolumeServer:
         await asyncio.gather(*(one(i) for i in range(wc.total)
                                if i != wc.index))
         return resp
+
+    async def h_traces(self, req: web.Request) -> web.Response:
+        """/debug/traces: recent + slowest-N traces from the in-memory
+        span ring; under -workers, any worker answers for the whole
+        host by merging its siblings' rings (like /metrics)."""
+        try:
+            recent = int(req.query.get("n", 20))
+            slowest = int(req.query.get("slowest", 10))
+            payload = tracing.traces_dict(recent=recent, slowest=slowest)
+        except ValueError:
+            return web.json_response({"error": "bad n/slowest"},
+                                     status=400)
+        wc = self.worker_ctx
+        if wc is not None and not self._is_worker_hop(req):
+            payloads = [payload]
+            for _, body in await self._sibling_get(
+                    f"/debug/traces?n={recent}&slowest={slowest}"):
+                try:
+                    payloads.append(json.loads(body))
+                except ValueError:
+                    continue
+            payload = tracing.merge_payloads(payloads, recent=recent,
+                                             slowest=slowest)
+        return web.json_response(payload)
+
+    async def h_requests(self, req: web.Request) -> web.Response:
+        """/debug/requests: currently in-flight spans with their age —
+        the wedged-request detector; -workers aggregated like above."""
+        payload = tracing.requests_dict()
+        wc = self.worker_ctx
+        if wc is not None and not self._is_worker_hop(req):
+            rows = payload["requests"]
+            for i, body in await self._sibling_get("/debug/requests"):
+                try:
+                    sib = json.loads(body)
+                except ValueError:
+                    continue
+                for r in sib.get("requests", ()):
+                    r["worker"] = i
+                    rows.append(r)
+            rows.sort(key=lambda r: -r.get("age_ms", 0))
+            payload = {"inflight": len(rows), "requests": rows}
+        return web.json_response(payload)
 
     async def h_breakers(self, req: web.Request) -> web.Response:
         """Circuit-breaker states of this server's upstream hops

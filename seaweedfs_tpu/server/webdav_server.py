@@ -113,15 +113,28 @@ class WebDavServer:
     # ---- dispatch ----
 
     async def dispatch(self, req: web.Request) -> web.StreamResponse:
+        from ..util import tracing
         path = "/" + unquote(req.match_info["path"])
         while "//" in path:
             path = path.replace("//", "/")
         if path != "/":
             path = path.rstrip("/")
+        if req.method == "GET" and path in ("/__debug__/traces",
+                                            "/__debug__/requests"):
+            # same shared handlers the filer/S3 surfaces register
+            h_traces, h_requests = tracing.debug_handlers()
+            return await (h_traces if path.endswith("traces")
+                          else h_requests)(req)
         handler = getattr(self, f"h_{req.method.lower()}", None)
         if handler is None:
             return web.Response(status=405)
-        return await handler(req, path)
+        # webdav-tier entry span: child client/volume/store spans hang
+        # off it exactly as on the filer/S3 read paths
+        with tracing.start_root("webdav", req.method.lower(),
+                                headers=req.headers) as sp:
+            resp = await handler(req, path)
+            sp.status = "ok" if resp.status < 400 else str(resp.status)
+            return resp
 
     # ---- methods ----
 

@@ -157,6 +157,13 @@ async def proxy_request(req, session, target: str, token: str):
     headers[WORKER_HEADER] = token
     if req.remote:
         headers[FORWARDED_HEADER] = req.remote
+    # trace propagation: the caller's proxy span (set on the context by
+    # the routing middleware) becomes the parent of the sibling's
+    # server span, so a cross-worker hop stays ONE trace — this
+    # overrides the client's original traceparent, which the proxy
+    # span already chains to
+    from ..util import tracing
+    tracing.inject(headers)
     body = None
     if req.method not in ("GET", "HEAD"):
         cl = req.headers.get("Content-Length", "")

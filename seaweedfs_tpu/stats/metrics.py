@@ -64,6 +64,17 @@ if HAVE_PROMETHEUS:
     CACHE_USED_BYTES = Gauge(
         "SeaweedFS_cache_used_bytes", "bytes currently held per cache",
         ["cache"], registry=REGISTRY)
+    # distributed tracing (util/tracing.py): every finished span feeds
+    # this, so the trace ring and Prometheus agree by construction.
+    # tier: s3|webdav|filer|client|proxy|volume|store|ec|replicate
+    REQUEST_DURATION = Histogram(
+        "SeaweedFS_request_duration_seconds",
+        "traced span duration per tier/op/status",
+        ["tier", "op", "status"], registry=REGISTRY)
+    METRICS_PUSH_ERRORS = Counter(
+        "SeaweedFS_metrics_push_errors_total",
+        "failed pushes to the configured metrics gateway",
+        registry=REGISTRY)
 
     def metrics_text() -> bytes:
         return generate_latest(REGISTRY)
@@ -79,7 +90,12 @@ def merge_metrics_texts(texts: "list[bytes]") -> bytes:
 
     Counters, gauges, and histogram buckets are summed per
     (name, labels); `*_created` timestamps take the min (first birth);
-    HELP/TYPE comments are kept from their first appearance."""
+    HELP/TYPE comments are kept from their first appearance.
+
+    Integral sums are emitted WITHOUT a trailing `.0` and never in
+    exponent notation: `repr(float)` rendered a summed counter of 123
+    as `123.0` and a large one as `1.2e+16`, both of which surprise
+    text-format consumers that treat counters as integers."""
     order: list[tuple[str, bytes]] = []   # ("comment"|"sample", key)
     seen_comments: set[bytes] = set()
     sums: dict[bytes, float] = {}
@@ -112,21 +128,54 @@ def merge_metrics_texts(texts: "list[bytes]") -> bytes:
         if kind == "comment":
             out.append(item)
         else:
-            out.append(item + b" " + repr(sums[item]).encode())
+            out.append(item + b" " + _fmt_value(sums[item]))
     return b"\n".join(out) + b"\n" if out else b""
 
 
+def _fmt_value(val: float) -> bytes:
+    """Prometheus text-format value: integral floats render as plain
+    integers (no `.0`, no exponent — `int(float)` is exact for any
+    float that is_integer()); fractional values keep full precision."""
+    if val != val or val in (float("inf"), float("-inf")):
+        return repr(val).encode()
+    if float(val).is_integer():
+        return b"%d" % int(val)
+    return repr(val).encode()
+
+
 async def push_loop(gateway: str, job: str,
-                    interval_seconds: float = 15.0) -> None:
-    """LoopPushingMetric (metrics.go:109-137)."""
+                    interval_seconds: float = 15.0,
+                    max_backoff_seconds: float = 300.0) -> None:
+    """LoopPushingMetric (metrics.go:109-137).
+
+    Failures are COUNTED (SeaweedFS_metrics_push_errors_total) and
+    LOGGED — the first failure and every healthy<->failing transition
+    at WARNING/INFO — while the push interval backs off exponentially
+    so a long-dead gateway neither floods the log nor gets hammered."""
+    from ..util import glog
     if not HAVE_PROMETHEUS or not gateway:
         return
     loop = asyncio.get_running_loop()
+    failing = False
+    delay = interval_seconds
     while True:
         try:
             await loop.run_in_executor(
                 None, lambda: push_to_gateway(gateway, job=job,
                                               registry=REGISTRY))
-        except Exception:
-            pass
-        await asyncio.sleep(interval_seconds)
+            if failing:
+                glog.info("metrics push to %s recovered (job=%s)",
+                          gateway, job)
+            failing = False
+            delay = interval_seconds
+        except Exception as e:  # noqa: BLE001 — the pusher must outlive
+            # any gateway-side failure shape, but never silently
+            METRICS_PUSH_ERRORS.inc()
+            if not failing:
+                glog.warning(
+                    "metrics push to %s failed: %s %s (backing off; "
+                    "logged once until recovery)", gateway,
+                    type(e).__name__, e)
+            failing = True
+            delay = min(delay * 2, max_backoff_seconds)
+        await asyncio.sleep(delay)

@@ -17,7 +17,7 @@ from ..ec import gf
 from ..ec.ec_volume import EcVolume, NotFoundError as EcNotFound
 from ..ec.locate import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE
 from ..pb import messages as pb
-from ..util import failpoints
+from ..util import failpoints, tracing
 from . import types as t
 from .needle import Needle
 from .super_block import ReplicaPlacement
@@ -126,8 +126,13 @@ class Store:
                 self.volumes[vid] = self._own(Volume(
                     d, col, vid, create_if_missing=False,
                     needle_map_kind=self.index_type))
-            except Exception:
-                # backend unreachable or not configured yet: skip
+            except Exception as e:  # noqa: BLE001 — any backend shape
+                # backend unreachable or not configured yet: skip, but
+                # an operator must be able to see WHY a tiered volume
+                # did not come up
+                from ..util import glog
+                glog.warning("tiered volume %d (%s): not loaded: %s",
+                             vid, path, e)
                 continue
         for path in glob.glob(os.path.join(d, "*.ecx")):
             m = _EC_RE.match(os.path.basename(path))
@@ -259,15 +264,18 @@ class Store:
         # faults hit every wire shape. One dict-emptiness check when
         # disarmed.
         failpoints.sync_fail("store.write")
-        v = self.volumes.get(vid)
-        if v is None:
-            raise NotFound(f"volume {vid} not found")
-        result = v.write_needle(n)
-        # AFTER the durable append: dropping first would let a racing
-        # reader re-populate the old bytes between drop and write
-        if self.needle_cache is not None:
-            self.needle_cache.invalidate(vid, n.id)
-        return result
+        with tracing.start("store", "write", vid=vid) as sp:
+            v = self.volumes.get(vid)
+            if v is None:
+                sp.status = "404"
+                raise NotFound(f"volume {vid} not found")
+            result = v.write_needle(n)
+            sp.nbytes = len(n.data)
+            # AFTER the durable append: dropping first would let a racing
+            # reader re-populate the old bytes between drop and write
+            if self.needle_cache is not None:
+                self.needle_cache.invalidate(vid, n.id)
+            return result
 
     def _cached(self, vid: int, needle_id: int, cookie: int | None,
                 count_miss: bool = True,
@@ -310,38 +318,51 @@ class Store:
     def read_needle(self, vid: int, needle_id: int,
                     cookie: int | None = None) -> Needle:
         failpoints.sync_fail("store.read")  # chaos site (see store.write)
-        n = self._cached(vid, needle_id, cookie)
-        if n is not None:
-            return n
-        # snapshot the volume's mutation generation BEFORE the disk
-        # read: a write/delete landing between our read and our put
-        # bumps it, and put() then refuses the stale fill
-        nc = self.needle_cache
-        gen = nc.generation(vid) if nc is not None else 0
-        v = self.volumes.get(vid)
-        if v is not None:
-            try:
-                n = v.read_needle(needle_id, cookie)
-            except OSError:
-                if vid not in self.volumes:
-                    # the volume was destroyed mid-read (TTL
-                    # reclamation / admin delete): a clean 404, not a
-                    # bad-file-descriptor 500
-                    raise NotFound(f"volume {vid} was removed")
-                raise
-            if nc is not None:
-                nc.put(vid, needle_id, n, gen=gen)
-            return n
-        ev = self.ec_volumes.get(vid)
-        if ev is not None:
-            try:
-                n = ev.read_needle(needle_id, cookie)
-            except EcNotFound as e:
-                raise NotFound(str(e))
-            if nc is not None:
-                nc.put(vid, needle_id, n, gen=gen)
-            return n
-        raise NotFound(f"volume {vid} not found")
+        # the store span records WHERE the bytes came from — cache,
+        # pread, or EC gather/reconstruct — the per-request attribution
+        # the degraded-read literature says dominates tail latency
+        with tracing.start("store", "read", vid=vid) as sp:
+            n = self._cached(vid, needle_id, cookie)
+            if n is not None:
+                sp.set("source", "cache")
+                sp.nbytes = len(n.data)
+                return n
+            # snapshot the volume's mutation generation BEFORE the disk
+            # read: a write/delete landing between our read and our put
+            # bumps it, and put() then refuses the stale fill
+            nc = self.needle_cache
+            gen = nc.generation(vid) if nc is not None else 0
+            v = self.volumes.get(vid)
+            if v is not None:
+                try:
+                    n = v.read_needle(needle_id, cookie)
+                except OSError:
+                    if vid not in self.volumes:
+                        # the volume was destroyed mid-read (TTL
+                        # reclamation / admin delete): a clean 404, not
+                        # a bad-file-descriptor 500
+                        sp.status = "404"
+                        raise NotFound(f"volume {vid} was removed")
+                    raise
+                if nc is not None:
+                    nc.put(vid, needle_id, n, gen=gen)
+                sp.set("source", "pread")
+                sp.nbytes = len(n.data)
+                return n
+            ev = self.ec_volumes.get(vid)
+            if ev is not None:
+                try:
+                    n = ev.read_needle(needle_id, cookie)
+                except EcNotFound as e:
+                    sp.status = "404"
+                    raise NotFound(str(e))
+                if nc is not None:
+                    nc.put(vid, needle_id, n, gen=gen)
+                sp.set("source", "ec")
+                sp.nbytes = len(n.data)
+                return n
+            sp.status = "404"
+            raise NotFound(f"volume {vid} not found")
 
     def delete_needle(self, vid: int, n: Needle) -> int:
         v = self.volumes.get(vid)

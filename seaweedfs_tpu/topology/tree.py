@@ -88,6 +88,10 @@ class Rack:
             n.rack = self
             self.nodes[node_id] = n
         n.max_volume_count = max_volumes
+        # refresh on every pulse: a server that learns/changes its
+        # -publicUrl after registration (workers bind ephemeral ports
+        # at start) must not stay pinned to the stale advertisement
+        n.public_url = public_url
         n.last_seen = time.time()
         return n
 

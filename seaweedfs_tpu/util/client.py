@@ -15,6 +15,7 @@ of failing the read.
 from __future__ import annotations
 
 from ..security import tls
+from . import tracing
 from .resilience import BreakerRegistry, RetryBudget, RetryPolicy
 from .singleflight import SingleFlight
 
@@ -143,36 +144,55 @@ class WeedClient:
         behind its own circuit breaker so a long-dead master costs
         microseconds, not connect timeouts."""
         last: object = None
-        async for _ in self.retry.attempts():
-            for _ in range(max(1, len(self.master_seeds))):
-                br = self.breakers.get(f"master:{self.master_url}")
-                if not br.allow():
-                    last = last or f"master {self.master_url} circuit open"
-                    self._rotate_seed()
-                    continue
-                try:
-                    async with self.http.get(
-                            tls.url(self.master_url, path),
-                            params=params,
-                            timeout=MASTER_TIMEOUT) as resp:
-                        body = await resp.json()
-                        if resp.status in (502, 503):
-                            # reachable follower proxying a dead leader /
-                            # no leader yet: the NEXT seed may already be
-                            # the new leader
-                            last = body.get("error",
-                                            f"http {resp.status}")
-                            br.record_success()   # reachable, not broken
-                            self._rotate_seed()
-                            continue
-                        br.record_success()
-                        return body
-                except (aiohttp.ClientError, asyncio.TimeoutError,
-                        OSError) as e:
-                    last = e
-                    br.record_failure()
-                    self._rotate_seed()
-        raise OperationError(f"master unreachable: {last}")
+        sp = tracing.start("client", path.rsplit("/", 1)[-1] or "master")
+        headers: dict = {}
+        if sp:
+            tracing.inject(headers, sp)
+        attempt = 0
+        try:
+            async for _ in self.retry.attempts():
+                attempt += 1
+                if attempt > 1:
+                    sp.event("retry", attempt=attempt)
+                for _ in range(max(1, len(self.master_seeds))):
+                    br = self.breakers.get(f"master:{self.master_url}")
+                    if not br.allow():
+                        last = last or \
+                            f"master {self.master_url} circuit open"
+                        sp.event("breaker_open", upstream=self.master_url)
+                        self._rotate_seed()
+                        continue
+                    try:
+                        async with self.http.get(
+                                tls.url(self.master_url, path),
+                                params=params, headers=headers,
+                                timeout=MASTER_TIMEOUT) as resp:
+                            body = await resp.json()
+                            if resp.status in (502, 503):
+                                # reachable follower proxying a dead
+                                # leader / no leader yet: the NEXT seed
+                                # may already be the new leader
+                                last = body.get("error",
+                                                f"http {resp.status}")
+                                br.record_success()  # reachable, not broken
+                                sp.event("seed_rotate",
+                                         status=resp.status)
+                                self._rotate_seed()
+                                continue
+                            br.record_success()
+                            sp.status = "ok"
+                            return body
+                    except (aiohttp.ClientError, asyncio.TimeoutError,
+                            OSError) as e:
+                        last = e
+                        br.record_failure()
+                        sp.event("seed_rotate",
+                                 error=f"{type(e).__name__} {e}"[:120])
+                        self._rotate_seed()
+            sp.status = "error"
+            raise OperationError(f"master unreachable: {last}")
+        finally:
+            sp.finish()
 
     def _rotate_seed(self) -> None:
         if len(self.master_seeds) > 1:
@@ -273,33 +293,48 @@ class WeedClient:
             # fetch that started during the POST's round trip read the
             # old body from the server and would otherwise re-pin it.
             self.chunk_cache.delete(fid)
+        sp = tracing.start("client", "upload", fid=fid, upstream=url)
+        if sp:
+            tracing.inject(headers, sp)
         br = self.breakers.get(url)
         last: object = None
-        async for _ in self.retry.attempts():
-            if not br.allow():
-                last = last or f"upload {fid}: {url} circuit open"
-                break
-            try:
-                async with self.http.post(
-                        tls.url(url, f"/{fid}"), data=data,
-                        params=params, headers=headers,
-                        timeout=DATA_TIMEOUT) as resp:
-                    body = await resp.json()
-                    if resp.status in (200, 201):
-                        br.record_success()
-                        if self.chunk_cache is not None:
-                            self.chunk_cache.delete(fid)
-                        return body
-                    if resp.status < 500:
-                        br.record_success()   # server healthy, we erred
-                        raise OperationError(f"upload {fid}: {body}")
-                    last = f"upload {fid}: {body}"
+        attempt = 0
+        try:
+            async for _ in self.retry.attempts():
+                attempt += 1
+                if attempt > 1:
+                    sp.event("retry", attempt=attempt)
+                if not br.allow():
+                    last = last or f"upload {fid}: {url} circuit open"
+                    sp.event("breaker_open", upstream=url)
+                    break
+                try:
+                    async with self.http.post(
+                            tls.url(url, f"/{fid}"), data=data,
+                            params=params, headers=headers,
+                            timeout=DATA_TIMEOUT) as resp:
+                        body = await resp.json()
+                        if resp.status in (200, 201):
+                            br.record_success()
+                            if self.chunk_cache is not None:
+                                self.chunk_cache.delete(fid)
+                            sp.status = "ok"
+                            sp.nbytes = len(data)
+                            return body
+                        if resp.status < 500:
+                            br.record_success()  # server healthy, we erred
+                            sp.status = str(resp.status)
+                            raise OperationError(f"upload {fid}: {body}")
+                        last = f"upload {fid}: {body}"
+                        br.record_failure()
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError, ValueError) as e:
+                    last = f"upload {fid}: {type(e).__name__} {e}"
                     br.record_failure()
-            except (aiohttp.ClientError, asyncio.TimeoutError,
-                    OSError, ValueError) as e:
-                last = f"upload {fid}: {type(e).__name__} {e}"
-                br.record_failure()
-        raise OperationError(str(last), retryable=True)
+            sp.status = "error"
+            raise OperationError(str(last), retryable=True)
+        finally:
+            sp.finish()
 
     async def upload_manifest(self, fid: str, url: str, manifest,
                               ttl: str = "", auth: str = "") -> dict:
@@ -420,87 +455,119 @@ class WeedClient:
 
         A clean short body (server's Content-Length honored) ends the
         stream normally — sparse/short chunks stay the caller's
-        zero-fill business, exactly as before."""
+        zero-fill business, exactly as before.
+
+        The whole read is one client-tier span; every replica rotation,
+        mid-body Range resume, breaker demotion and lookup refresh is a
+        span event, so a degraded read's recovery dance is visible in
+        the trace instead of only in aggregate counters. The span is
+        finished in the generator's finally (an abandoned stream still
+        records what it did). NOT entered as a context manager: an
+        async generator body runs in its consumer's context, so a
+        contextvar set here could leak into (or fail to reset from) a
+        different task — the volume hop is parented via the explicit
+        traceparent header instead."""
+        sp = tracing.start("client", "read", fid=fid)
         vid = fid.split(",")[0]
         sent = 0                    # bytes already yielded
         last: str = "no locations"
         stalled = 0
-        while stalled < 2:
-            # keep rotating while bytes ADVANCE (every replica may be
-            # flaky under injected faults); give up only after two
-            # consecutive lookup rounds with zero forward progress
-            round_start = sent
-            try:
-                locs = await self.lookup(vid)
-            except OperationError as e:
-                last = str(e)
-                break
-            # blocking() is a side-effect-free peek — allow() here
-            # would consume half-open probes for locations the read
-            # may never touch, wedging recovered upstreams half-open
-            locs = sorted(locs, key=lambda l: self.breakers.get(
-                l.get("publicUrl", l.get("url", ""))).blocking())
-            for loc in locs:
-                upstream = loc.get("publicUrl", loc.get("url", ""))
-                url = tls.url(upstream, f"/{fid}")
-                br = self.breakers.get(upstream)
-                cur = offset + sent
-                headers = {}
-                if cur or size >= 0:
-                    end = "" if size < 0 else str(offset + size - 1)
-                    headers["Range"] = f"bytes={cur}-{end}"
+        tries = 0
+        try:
+            while stalled < 2:
+                # keep rotating while bytes ADVANCE (every replica may be
+                # flaky under injected faults); give up only after two
+                # consecutive lookup rounds with zero forward progress
+                round_start = sent
                 try:
-                    async with self.http.get(
-                            url, headers=headers,
-                            timeout=DATA_TIMEOUT) as resp:
-                        if resp.status in (404, 410):
-                            # authoritative: the holder says it is gone
-                            br.record_success()
-                            raise OperationError(f"read {fid}: not found")
-                        if resp.status >= 400:
-                            # an error body must never masquerade as
-                            # file content; 5xx => try the next holder
-                            body = await resp.read()
-                            last = (f"http {resp.status} "
-                                    f"{body[:200].decode(errors='replace')}")
-                            if resp.status >= 500:
-                                br.record_failure()
-                            else:
+                    locs = await self.lookup(vid)
+                except OperationError as e:
+                    last = str(e)
+                    break
+                # blocking() is a side-effect-free peek — allow() here
+                # would consume half-open probes for locations the read
+                # may never touch, wedging recovered upstreams half-open
+                locs = sorted(locs, key=lambda l: self.breakers.get(
+                    l.get("publicUrl", l.get("url", ""))).blocking())
+                for loc in locs:
+                    upstream = loc.get("publicUrl", loc.get("url", ""))
+                    url = tls.url(upstream, f"/{fid}")
+                    br = self.breakers.get(upstream)
+                    cur = offset + sent
+                    headers = {}
+                    if sp:
+                        tracing.inject(headers, sp)
+                    tries += 1
+                    if tries > 1:
+                        # a second holder is only tried after the first
+                        # failed: this IS the replica failover, resuming
+                        # from the exact byte reached when mid-body
+                        sp.event("replica_rotate", upstream=upstream,
+                                 resume_at=cur, last=str(last)[:120])
+                    if cur or size >= 0:
+                        end = "" if size < 0 else str(offset + size - 1)
+                        headers["Range"] = f"bytes={cur}-{end}"
+                        if sent and tries > 1:
+                            sp.event("range_resume", at=cur)
+                    try:
+                        async with self.http.get(
+                                url, headers=headers,
+                                timeout=DATA_TIMEOUT) as resp:
+                            if resp.status in (404, 410):
+                                # authoritative: the holder says gone
                                 br.record_success()
-                            continue
-                        # server ignored Range (200 to a mid-file
-                        # resume): skip the prefix we already delivered
-                        skip = cur if resp.status == 200 else 0
-                        async for chunk in resp.content.iter_chunked(
-                                1 << 16):
-                            if skip:
-                                if len(chunk) <= skip:
-                                    skip -= len(chunk)
-                                    continue
-                                chunk = chunk[skip:]
-                                skip = 0
-                            if size >= 0:
-                                remain = size - sent
-                                if len(chunk) > remain:
-                                    chunk = chunk[:remain]
-                            if chunk:
-                                sent += len(chunk)
-                                yield chunk
-                            if size >= 0 and sent >= size:
-                                break
-                        br.record_success()
-                        return
-                except (aiohttp.ClientError, asyncio.TimeoutError,
-                        OSError) as e:
-                    # mid-body deaths land here (aiohttp raises
-                    # ClientPayloadError when the peer dies before
-                    # Content-Length is satisfied): rotate and resume
-                    last = f"{type(e).__name__} {e}"
-                    br.record_failure()
-                    continue
-            stalled = stalled + 1 if sent == round_start else 0
-            self.invalidate(vid)    # stale holders: refresh + retry
-        raise OperationError(f"read {fid}: {last}")
+                                sp.status = "404"
+                                raise OperationError(
+                                    f"read {fid}: not found")
+                            if resp.status >= 400:
+                                # an error body must never masquerade as
+                                # file content; 5xx => try the next holder
+                                body = await resp.read()
+                                last = (f"http {resp.status} "
+                                        f"{body[:200].decode(errors='replace')}")
+                                if resp.status >= 500:
+                                    br.record_failure()
+                                else:
+                                    br.record_success()
+                                continue
+                            # server ignored Range (200 to a mid-file
+                            # resume): skip the delivered prefix
+                            skip = cur if resp.status == 200 else 0
+                            async for chunk in resp.content.iter_chunked(
+                                    1 << 16):
+                                if skip:
+                                    if len(chunk) <= skip:
+                                        skip -= len(chunk)
+                                        continue
+                                    chunk = chunk[skip:]
+                                    skip = 0
+                                if size >= 0:
+                                    remain = size - sent
+                                    if len(chunk) > remain:
+                                        chunk = chunk[:remain]
+                                if chunk:
+                                    sent += len(chunk)
+                                    yield chunk
+                                if size >= 0 and sent >= size:
+                                    break
+                            br.record_success()
+                            sp.status = "ok"
+                            return
+                    except (aiohttp.ClientError, asyncio.TimeoutError,
+                            OSError) as e:
+                        # mid-body deaths land here (aiohttp raises
+                        # ClientPayloadError when the peer dies before
+                        # Content-Length is satisfied): rotate + resume
+                        last = f"{type(e).__name__} {e}"
+                        br.record_failure()
+                        continue
+                stalled = stalled + 1 if sent == round_start else 0
+                self.invalidate(vid)    # stale holders: refresh + retry
+                sp.event("lookup_refresh", stalled=stalled)
+            sp.status = sp.status or "error"
+            raise OperationError(f"read {fid}: {last}")
+        finally:
+            sp.finish(nbytes=sent)
 
     async def read(self, fid: str, offset: int = 0,
                    size: int = -1) -> bytes:

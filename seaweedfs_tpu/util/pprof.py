@@ -4,6 +4,11 @@ Reference: weed/util/pprof.go `SetupProfiling(cpuProfile, memProfile)`,
 wired at command/master.go:74-75, volume.go, mount_std.go:28. Python
 analog: cProfile stats dumped at exit for CPU, tracemalloc top-25 for
 memory.
+
+Under `-workers N` every worker process runs this same setup with the
+same flag values; each dump path is therefore suffixed `.w<index>`
+(e.g. `prof.out.w1`) so N workers don't clobber one file — the
+supervisor's own process (workerIndex < 0) keeps the bare path.
 """
 
 from __future__ import annotations
@@ -11,24 +16,32 @@ from __future__ import annotations
 import atexit
 
 
-def setup_profiling(cpu_profile: str = "", mem_profile: str = "") -> None:
+def profile_path(path: str, worker_index: int = -1) -> str:
+    """The actual dump path: `.w<index>`-suffixed under -workers."""
+    return f"{path}.w{worker_index}" if worker_index >= 0 else path
+
+
+def setup_profiling(cpu_profile: str = "", mem_profile: str = "",
+                    worker_index: int = -1) -> None:
     if cpu_profile:
         import cProfile
         prof = cProfile.Profile()
         prof.enable()
+        cpu_path = profile_path(cpu_profile, worker_index)
 
         def _dump_cpu() -> None:
             prof.disable()
-            prof.dump_stats(cpu_profile)
+            prof.dump_stats(cpu_path)
 
         atexit.register(_dump_cpu)
     if mem_profile:
         import tracemalloc
         tracemalloc.start(25)
+        mem_path = profile_path(mem_profile, worker_index)
 
         def _dump_mem() -> None:
             snap = tracemalloc.take_snapshot()
-            with open(mem_profile, "w") as f:
+            with open(mem_path, "w") as f:
                 for stat in snap.statistics("lineno")[:100]:
                     f.write(f"{stat}\n")
 

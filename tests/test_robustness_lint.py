@@ -26,6 +26,61 @@ def test_util_and_master_are_clean():
     assert problems == []
 
 
+def test_stats_and_wider_tree_pass_metrics_hygiene():
+    """stats/ lost its exemption (the silent push_loop handler lived
+    there) and every registered metric must carry the SeaweedFS_
+    namespace + help text; the tracing-wired trees stay span-clean."""
+    problems = lint_paths([
+        os.path.join(REPO, "seaweedfs_tpu", "stats"),
+        os.path.join(REPO, "seaweedfs_tpu", "storage"),
+        os.path.join(REPO, "seaweedfs_tpu", "s3"),
+        os.path.join(REPO, "seaweedfs_tpu", "ec"),
+    ])
+    assert problems == []
+
+
+def test_lint_catches_metric_hygiene_violations(tmp_path):
+    bad = tmp_path / "badmetrics.py"
+    bad.write_text(textwrap.dedent("""
+        from prometheus_client import Counter, Histogram
+        A = Counter("my_requests_total", "requests")       # bad prefix
+        B = Counter("SeaweedFS_Requests_total", "x")       # upper lead
+        C = Histogram("SeaweedFS_request_seconds", "")     # empty help
+        OK = Counter("SeaweedFS_volumeServer_request_total",
+                     "needle requests")
+    """))
+    problems = lint_file(str(bad))
+    assert len(problems) == 3
+    assert "my_requests_total" in problems[0]
+    assert "SeaweedFS_Requests_total" in problems[1]
+    assert "help" in problems[2]
+
+
+def test_lint_catches_span_finish_outside_finally(tmp_path):
+    bad = tmp_path / "badspan.py"
+    bad.write_text(textwrap.dedent("""
+        from seaweedfs_tpu.util import tracing
+
+        def f():
+            sp = tracing.start("x", "y")
+            sp.finish("ok")                     # not exception-safe
+
+        def g():
+            read_span = tracing.start("x", "y")
+            try:
+                work()
+            finally:
+                read_span.finish()              # fine
+
+        def h():
+            with tracing.start("x", "y"):       # fine: no finish at all
+                work()
+    """))
+    problems = lint_file(str(bad))
+    assert len(problems) == 1
+    assert "finish() outside a finally" in problems[0]
+
+
 def test_lint_catches_silent_broad_handlers(tmp_path):
     bad = tmp_path / "bad.py"
     bad.write_text(textwrap.dedent("""

@@ -82,13 +82,17 @@ def test_worker_context_state_files(tmp_path):
 def test_merge_metrics_texts():
     from seaweedfs_tpu.stats.metrics import merge_metrics_texts
     t1 = (b"# HELP w writes\n# TYPE w counter\n"
-          b'w_total{op="write"} 3.0\nvols 2.0\nw_created 100.0\n')
+          b'w_total{op="write"} 3.0\nvols 2.0\nw_created 100.0\n'
+          b"w_ratio 0.25\n")
     t2 = (b"# HELP w writes\n# TYPE w counter\n"
-          b'w_total{op="write"} 4.0\nvols 5.0\nw_created 90.0\n')
+          b'w_total{op="write"} 4.0\nvols 5.0\nw_created 90.0\n'
+          b"w_ratio 0.5\n")
     merged = merge_metrics_texts([t1, t2]).decode()
-    assert 'w_total{op="write"} 7.0' in merged
-    assert "vols 7.0" in merged
-    assert "w_created 90.0" in merged          # min, not sum
+    # integral sums render as plain integers (no `.0`, no exponent)
+    assert 'w_total{op="write"} 7\n' in merged
+    assert "vols 7\n" in merged
+    assert "w_created 90\n" in merged          # min, not sum
+    assert "w_ratio 0.75" in merged            # fractions keep precision
     assert merged.count("# HELP w writes") == 1
 
 

@@ -11,6 +11,11 @@ single-core regressions and multi-core scaling are one command:
     python tools/bench_needle.py zipf 1          # Zipfian hot-read mix,
                                                  # cache on vs off, with
                                                  # needle-cache hit rate
+    python tools/bench_needle.py trace 2         # after each run, pull
+                                                 # /debug/traces (merged
+                                                 # across workers) and
+                                                 # print the per-tier
+                                                 # latency breakdown
 
 Prints one JSON line per configuration:
     {"workers": 1, "write_rps": ..., "read_rps": ...}
@@ -37,6 +42,7 @@ import time
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
 BASE_PORT = 21700
 
 _RPS = re.compile(r"^(write|read):\s+([0-9.]+) req/s", re.M)
@@ -77,7 +83,8 @@ def _needle_cache_hit_rate(vol: str) -> "tuple[float, float] | None":
 
 def bench_one(workers: int, n: int, size: int, conc: int,
               cache_mb: "int | None" = None,
-              read_mode: str = "", read_n: int = 0) -> dict:
+              read_mode: str = "", read_n: int = 0,
+              trace: bool = False) -> dict:
     tmp = tempfile.mkdtemp(prefix=f"swtpu_bn_w{workers}_")
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     procs: list[subprocess.Popen] = []
@@ -101,6 +108,9 @@ def bench_one(workers: int, n: int, size: int, conc: int,
             vol += ["-workers", str(workers)]
         if cache_mb is not None:
             vol += ["-cache.mem", str(cache_mb)]
+        # extra volume-server flags, e.g. the tracing-overhead A/B:
+        #   SWTPU_BENCH_VOLFLAGS="-trace.sample 0" python tools/bench_needle.py zipf 1
+        vol += os.environ.get("SWTPU_BENCH_VOLFLAGS", "").split()
         spawn(*vol)
         _wait_assign(master)
         bench = [sys.executable, "-m", "seaweedfs_tpu.cli", "benchmark",
@@ -125,6 +135,14 @@ def bench_one(workers: int, n: int, size: int, conc: int,
         hm = _needle_cache_hit_rate(vol_addr)
         if hm is not None and sum(hm) > 0:
             row["hit_rate"] = round(hm[0] / (hm[0] + hm[1]), 4)
+        if trace:
+            # per-tier latency breakdown from the volume fleet's span
+            # ring (/debug/traces is whole-host: any worker merges its
+            # siblings' rings before answering)
+            import trace_table
+            print(f"--- per-tier trace breakdown (workers={workers}) "
+                  f"---", file=sys.stderr)
+            print(trace_table.breakdown([vol_addr]), file=sys.stderr)
         return row
     finally:
         for p in procs:
@@ -138,6 +156,7 @@ def bench_one(workers: int, n: int, size: int, conc: int,
 def main() -> None:
     args = sys.argv[1:]
     zipf = "zipf" in args
+    trace = "trace" in args
     sweep = [int(a) for a in args if a.isdigit()] or ([1] if zipf
                                                       else [1, 2])
     n = int(os.environ.get("SWTPU_BENCH_N", "10000"))
@@ -151,10 +170,12 @@ def main() -> None:
             for cache_mb in (32, 0):
                 print(json.dumps(bench_one(
                     w, n, size, conc, cache_mb=cache_mb,
-                    read_mode="zipf", read_n=read_n)), flush=True)
+                    read_mode="zipf", read_n=read_n,
+                    trace=trace)), flush=True)
         return
     for w in sweep:
-        print(json.dumps(bench_one(w, n, size, conc)), flush=True)
+        print(json.dumps(bench_one(w, n, size, conc, trace=trace)),
+              flush=True)
 
 
 if __name__ == "__main__":

@@ -36,6 +36,7 @@ import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
 
 BASE_PORT = 23400
 
@@ -296,6 +297,21 @@ async def run(args) -> int:
                 pass
         report["failpoint_hits"] = fired
         print(f"failpoint hits (surviving servers): {fired}")
+
+        if args.trace:
+            # per-tier latency breakdown from the survivors' span
+            # rings: under injected faults this is where the retry /
+            # failover time shows up as client-tier self time. ONE
+            # fetch round — table and JSON report must describe the
+            # same ring snapshot
+            import trace_table
+            addrs = [f"127.0.0.1:{BASE_PORT + 1 + i}"
+                     for i in range(n_servers - 1)]
+            rows = trace_table.rows_from_payloads(
+                [p for p in (trace_table.fetch(a) for a in addrs) if p])
+            print("--- per-tier trace breakdown (survivors) ---")
+            print(trace_table.render(rows))
+            report["trace_breakdown"] = rows
         if not args.quick and not any(fired.values()):
             print("FAIL: no failpoint ever fired — the chaos run "
                   "tested nothing")
@@ -336,6 +352,10 @@ def main() -> int:
     ap.add_argument("--error-bound", type=float, default=0.20,
                     help="max post-retry client error rate")
     ap.add_argument("--seed", type=int, default=1337)
+    ap.add_argument("--trace", action="store_true",
+                    help="pull /debug/traces from the surviving volume "
+                         "servers and print the per-tier latency "
+                         "breakdown table")
     ap.add_argument("--json", help="write the report to this path")
     ap.add_argument("--keep", action="store_true",
                     help="keep tmpdir + server logs")

@@ -1,14 +1,25 @@
-"""AST lint: forbid silently-swallowed broad exceptions.
+"""AST lint: robustness + observability hygiene.
 
-Flags any ``except`` handler that (a) catches ``Exception`` /
-``BaseException`` or is a bare ``except:``, AND (b) whose body is only
-``pass`` / ``continue`` — the shape that turns real faults invisible.
-Narrow handlers may still swallow (that is often correct: idempotent
-deletes, probe loops); broad ones must at least log.
+Three passes:
+
+1. Silent broad exceptions — any ``except`` handler that (a) catches
+   ``Exception`` / ``BaseException`` or is a bare ``except:``, AND (b)
+   whose body is only ``pass`` / ``continue`` — the shape that turns
+   real faults invisible. Narrow handlers may still swallow (often
+   correct: idempotent deletes, probe loops); broad ones must log.
+2. Metrics hygiene — every ``Counter``/``Gauge``/``Histogram``
+   construction must use a ``SeaweedFS_``-prefixed lowercase-starting
+   name (the registry's one namespace) and carry non-empty help text.
+3. Span hygiene — every explicit tracing ``<span>.finish(...)`` call
+   (on a name that looks like a span: ``sp``/``rsp``/``span``/
+   ``*_span``/``*_sp``) must sit inside a ``finally`` block, so an
+   exception on any path can never leak an unfinished span out of the
+   in-flight table. ``with tracing.start(...)`` needs no finish and
+   is exempt by construction.
 
 Run as a tier-1 test (tests/test_robustness_lint.py) over
-``seaweedfs_tpu/server/`` so the data plane can never regress, or by
-hand over any path:
+``seaweedfs_tpu/server/`` (+ util, master, stats) so the data plane
+can never regress, or by hand over any path:
 
     python tools/lint_robustness.py [path ...]
 """
@@ -17,12 +28,21 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DEFAULT_PATHS = [os.path.join(REPO, "seaweedfs_tpu", "server")]
+DEFAULT_PATHS = [os.path.join(REPO, "seaweedfs_tpu", "server"),
+                 os.path.join(REPO, "seaweedfs_tpu", "stats")]
 
 BROAD = {"Exception", "BaseException"}
+
+METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
+# SeaweedFS_ prefix then a lowercase-led snake-ish name; interior
+# camelCase segments are allowed (the reference's own idiom:
+# SeaweedFS_volumeServer_request_total)
+METRIC_NAME_RE = re.compile(r"^SeaweedFS_[a-z][A-Za-z0-9_]*$")
+SPAN_NAME_RE = re.compile(r"^(sp|rsp|span|.*_span|.*_sp)$")
 
 
 def _is_broad(handler: ast.ExceptHandler) -> bool:
@@ -43,6 +63,57 @@ def _is_silent(handler: ast.ExceptHandler) -> bool:
                for s in handler.body)
 
 
+def _metric_problems(path: str, node: ast.Call) -> list[str]:
+    """Pass 2: metrics hygiene on Counter/Gauge/Histogram calls."""
+    func = node.func
+    name = func.id if isinstance(func, ast.Name) else (
+        func.attr if isinstance(func, ast.Attribute) else "")
+    if name not in METRIC_CTORS or len(node.args) < 1:
+        return []
+    problems = []
+    first = node.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        if not METRIC_NAME_RE.match(first.value):
+            problems.append(
+                f"{path}:{node.lineno}: metric name {first.value!r} "
+                f"must match SeaweedFS_[a-z]... (one registry "
+                f"namespace, lowercase-led)")
+    help_arg = node.args[1] if len(node.args) > 1 else None
+    if help_arg is None or (isinstance(help_arg, ast.Constant)
+                            and not str(help_arg.value or "").strip()):
+        problems.append(
+            f"{path}:{node.lineno}: metric {name} needs non-empty "
+            f"help text")
+    return problems
+
+
+def _finally_calls(tree: ast.AST) -> set[int]:
+    """ids of every Call node located inside some `finally` block."""
+    inside: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call):
+                        inside.add(id(sub))
+    return inside
+
+
+def _span_finish_problem(path: str, node: ast.Call,
+                         in_finally: set[int]) -> list[str]:
+    """Pass 3: span.finish() must be exception-safe (in a finally)."""
+    func = node.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "finish"
+            and isinstance(func.value, ast.Name)
+            and SPAN_NAME_RE.match(func.value.id)):
+        return []
+    if id(node) in in_finally:
+        return []
+    return [f"{path}:{node.lineno}: span {func.value.id}.finish() "
+            f"outside a finally — an exception path would leak the "
+            f"span (use `with` or move the finish into finally)"]
+
+
 def lint_file(path: str) -> list[str]:
     with open(path, encoding="utf-8") as f:
         src = f.read()
@@ -51,6 +122,7 @@ def lint_file(path: str) -> list[str]:
     except SyntaxError as e:
         return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
     problems = []
+    in_finally = _finally_calls(tree)
     for node in ast.walk(tree):
         if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
                 and _is_silent(node):
@@ -59,6 +131,9 @@ def lint_file(path: str) -> list[str]:
             problems.append(
                 f"{path}:{node.lineno}: silent {what}: pass — narrow "
                 f"the exception type and/or glog the fault")
+        elif isinstance(node, ast.Call):
+            problems += _metric_problems(path, node)
+            problems += _span_finish_problem(path, node, in_finally)
     return problems
 
 

@@ -1,0 +1,101 @@
+"""Per-tier latency breakdown from /debug/traces payloads.
+
+Shared by tools/bench_needle.py (`trace` mode) and tools/chaos.py
+(`--trace`): pulls the trace rings of one or more daemons, folds the
+spans into per-(tier, op) rows of self-time — the non-overlapping
+"which tier ate the time" attribution computed by util/tracing.py —
+and renders an aligned text table:
+
+    tier      op       spans   p50ms   p95ms   avg_self  total_self
+    volume    read      1820     0.8     2.1        0.6      1092.0
+    store     read      1820     0.4     1.2        0.4       728.0
+
+Usage as a script:
+
+    python tools/trace_table.py host:port [host:port ...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+
+def fetch(addr: str, path: str = "/debug/traces",
+          n: int = 200, timeout: float = 10.0) -> dict | None:
+    """One daemon's trace payload, or None when unreachable."""
+    url = f"http://{addr}{path}?n={n}&slowest=50"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.load(r)
+    except (OSError, ValueError):
+        return None
+
+
+def rows_from_payloads(payloads: list[dict]) -> list[dict]:
+    """Fold trace payloads into per-(tier, op) rows, deduping spans
+    repeated between the recent and slowest lists."""
+    seen: set[tuple[str, str]] = set()
+    per: dict[tuple[str, str], list[tuple[float, float]]] = {}
+    for p in payloads:
+        if not p:
+            continue
+        for g in list(p.get("traces", ())) + list(p.get("slowest", ())):
+            for s in g.get("spans", ()):
+                key = (s.get("trace", ""), s.get("span", ""))
+                if key in seen:
+                    continue
+                seen.add(key)
+                per.setdefault((s.get("tier", "?"), s.get("op", "?")),
+                               []).append((s.get("dur_ms", 0.0),
+                                           s.get("self_ms",
+                                                 s.get("dur_ms", 0.0))))
+    rows = []
+    for (tier, op), vals in per.items():
+        durs = sorted(d for d, _ in vals)
+        selfs = [sf for _, sf in vals]
+
+        def pct(p: float) -> float:
+            return durs[min(len(durs) - 1, int(p / 100 * len(durs)))]
+
+        rows.append({
+            "tier": tier, "op": op, "spans": len(vals),
+            "p50_ms": round(pct(50), 2), "p95_ms": round(pct(95), 2),
+            "avg_self_ms": round(sum(selfs) / len(selfs), 3),
+            "total_self_ms": round(sum(selfs), 1),
+        })
+    rows.sort(key=lambda r: -r["total_self_ms"])
+    return rows
+
+
+def render(rows: list[dict]) -> str:
+    if not rows:
+        return "(no traced spans — is -trace.sample > 0?)"
+    cols = ["tier", "op", "spans", "p50_ms", "p95_ms",
+            "avg_self_ms", "total_self_ms"]
+    table = [cols] + [[str(r[c]) for c in cols] for r in rows]
+    widths = [max(len(line[i]) for line in table)
+              for i in range(len(cols))]
+    out = []
+    for line in table:
+        out.append("  ".join(v.ljust(w) for v, w in zip(line, widths)))
+    return "\n".join(out)
+
+
+def breakdown(addrs: list[str], paths: dict[str, str] | None = None
+              ) -> str:
+    """Fetch + fold + render in one call. `paths` overrides the debug
+    path per address (the filer/S3 gateways use /__debug__/traces)."""
+    payloads = []
+    for addr in addrs:
+        path = (paths or {}).get(addr, "/debug/traces")
+        payloads.append(fetch(addr, path))
+    return render(rows_from_payloads([p for p in payloads if p]))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(2)
+    print(breakdown(sys.argv[1:]))
