@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from .security import tls
 from .security.guard import parse_white_list
+from .util import tracing
 
 import argparse
 import asyncio
@@ -576,11 +577,17 @@ async def _run_master(args) -> None:
                      volume_preallocate=args.volumePreallocate,
                      worker_ctx=worker_ctx)
     await m.start()
+    push_task = None
     if args.metricsGateway:
         from .stats.metrics import push_loop
-        asyncio.create_task(push_loop(args.metricsGateway, "master"))
+        push_task = asyncio.create_task(
+            push_loop(args.metricsGateway, "master"))
     print(f"master listening on {m.url}")
-    await _serve_until_interrupt(m)
+    try:
+        await _serve_until_interrupt(m)
+    finally:
+        if push_task is not None:
+            push_task.cancel()
 
 
 async def _run_volume(args) -> None:
@@ -769,8 +776,11 @@ async def _run_filer_copy(args) -> None:
             async with sem:
                 try:
                     # hand the file object to FormData so aiohttp streams
-                    # it instead of holding whole files in memory
-                    with open(local, "rb") as f:
+                    # it instead of holding whole files in memory; open
+                    # and close leave the loop — N concurrent uploads
+                    # share it
+                    f = await tracing.run_in_executor(open, local, "rb")
+                    try:
                         form = aiohttp.FormData()
                         form.add_field("file", f,
                                        filename=os.path.basename(rel))
@@ -782,6 +792,8 @@ async def _run_filer_copy(args) -> None:
                                 print(f"copy {local}: http {resp.status} "
                                       f"{await resp.text()}")
                                 return False
+                    finally:
+                        await tracing.run_in_executor(f.close)
                 except (OSError, aiohttp.ClientError,
                         asyncio.TimeoutError) as e:
                     print(f"copy {local}: {e}")
@@ -926,6 +938,18 @@ def _walk_upload_files(dir_path: str, include: str) -> list[str]:
     return out
 
 
+def _read_file(path: str) -> bytes:
+    """Sync whole-file read, for executor round-trips off the loop."""
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _write_file(path: str, data: bytes) -> None:
+    """Sync whole-file write, for executor round-trips off the loop."""
+    with open(path, "wb") as f:
+        f.write(data)
+
+
 async def _run_upload(args) -> None:
     from .util.client import WeedClient
     max_mb = getattr(args, "maxMB", 0) or 0
@@ -937,8 +961,7 @@ async def _run_upload(args) -> None:
     async with WeedClient(args.master) as c:
         out = []
         for path in files:
-            with open(path, "rb") as f:
-                data = f.read()
+            data = await tracing.run_in_executor(_read_file, path)
             if max_mb > 0 and len(data) > max_mb * 1024 * 1024:
                 # auto-split into a chunk manifest (submit.go:112-199)
                 from .util.chunked import upload_in_chunks
@@ -967,8 +990,7 @@ async def _run_download(args) -> None:
     async with WeedClient(args.master) as c:
         data = await c.read(args.fid)
     out = args.output or args.fid.replace(",", "_")
-    with open(out, "wb") as f:
-        f.write(data)
+    await tracing.run_in_executor(_write_file, out, data)
     print(f"wrote {len(data)} bytes to {out}")
 
 
@@ -979,13 +1001,12 @@ async def _run_shell(args) -> None:
         await run_command(args.master, args.command)
         return
     print("seaweedfs_tpu shell; 'help' for commands, 'exit' to quit")
-    loop = asyncio.get_running_loop()
     # one env for the whole session so fs.cd working-directory state
     # carries across commands (shell_liner.go keeps one CommandEnv)
     async with CommandEnv(args.master) as env:
         while True:
             try:
-                line = await loop.run_in_executor(None, input, "> ")
+                line = await tracing.run_in_executor(input, "> ")
             except (EOFError, KeyboardInterrupt):
                 break
             line = line.strip()
@@ -1044,8 +1065,8 @@ class _RawConn:
     def close(self) -> None:
         try:
             self.w.close()
-        except Exception:  # noqa: BLE001
-            pass
+        except OSError:
+            pass  # already-dead socket: nothing left to release
 
 
 async def _run_benchmark(args) -> None:
@@ -1066,8 +1087,9 @@ async def _run_benchmark(args) -> None:
         if not args.idList:
             raise SystemExit("-write=false needs -list <fid file> "
                              "from an earlier write run")
-        with open(args.idList) as f:
-            fids = [ln.strip() for ln in f if ln.strip()]
+        raw = await tracing.run_in_executor(_read_file, args.idList)
+        fids = [ln.strip() for ln in raw.decode().splitlines()
+                if ln.strip()]
 
     master = args.master.split(",")[0]
     assign_q = "/dir/assign"
@@ -1163,8 +1185,9 @@ async def _run_benchmark(args) -> None:
                                for _ in range(args.concurrency)))
         wdt = time.perf_counter() - t0
         if args.idList:
-            with open(args.idList, "w") as f:
-                f.write("\n".join(fids) + "\n")
+            await tracing.run_in_executor(
+                _write_file, args.idList,
+                ("\n".join(fids) + "\n").encode())
 
     rdt = 0.0
     n_reads = 0
@@ -1263,22 +1286,29 @@ async def _run_backup(args) -> None:
                         if resp.status != 200:
                             raise RuntimeError(
                                 f"fetch {ext}: http {resp.status}")
-                        with open(tmp, "wb") as f:
+                        # volume-sized files: open/write/close leave
+                        # the loop the http session runs on
+                        f = await tracing.run_in_executor(
+                            open, tmp, "wb")
+                        try:
                             async for chunk in \
                                     resp.content.iter_chunked(1 << 20):
-                                f.write(chunk)
+                                await tracing.run_in_executor(
+                                    f.write, chunk)
+                        finally:
+                            await tracing.run_in_executor(f.close)
                     tmps.append((tmp, base + ext))
             except (RuntimeError, aiohttp.ClientError, OSError) as e:
                 for tmp, _ in tmps:
                     if os.path.exists(tmp):
-                        os.remove(tmp)
+                        await tracing.run_in_executor(os.remove, tmp)
                 print(f"full copy failed: {e}")
                 sys.exit(1)
             # swap .dat before .idx: a crash in between leaves old .idx +
             # new (superset) .dat, which the open-time integrity check
             # truncates to a consistent state; the reverse order is fatal
             for tmp, final in reversed(tmps):
-                os.replace(tmp, final)
+                await tracing.run_in_executor(os.replace, tmp, final)
             v = Volume(args.dir, collection, args.volumeId,
                        create_if_missing=False)
             print(f"full copy of volume {args.volumeId}: "
@@ -1540,7 +1570,6 @@ def main(argv: list[str] | None = None) -> None:
         glog.init(verbosity=args.verbosity,
                   log_dir=args.logdir or None,
                   logtostderr=args.logtostderr)
-        from .util import tracing
         tracing.init(sample=args.trace_sample, slow_ms=args.trace_slowms,
                      ring=args.trace_ring)
         if args.cpuprofile or args.memprofile:

@@ -12,6 +12,7 @@ import threading
 import time
 from typing import Callable
 
+from ..util import glog
 from .entry import Attr, Entry, new_directory_entry
 from .filechunks import FileChunk, minus_chunks
 from .filerstore import FilerStore, create_store
@@ -41,8 +42,11 @@ class Filer:
         for fn in self.listeners:
             try:
                 fn(old, new)
-            except Exception:
-                pass
+            except Exception as e:
+                # a broken listener must not block the mutation, but a
+                # replication sink silently missing events is data loss
+                glog.warning("filer listener %s failed: %r",
+                             getattr(fn, "__name__", fn), e)
 
     # ---- entry CRUD ----
 

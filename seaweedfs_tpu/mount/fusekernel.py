@@ -26,6 +26,8 @@ import struct
 import subprocess
 import threading
 
+from ..util import glog
+
 # ---------------------------------------------------------------------------
 # fusepy-compatible surface
 # ---------------------------------------------------------------------------
@@ -202,8 +204,12 @@ class FUSE:
             if hasattr(self.ops, "destroy"):
                 try:
                     self.ops.destroy(self.mountpoint)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # teardown must finish unmounting either way, but a
+                    # destroy() fault means dirty pages may not have
+                    # flushed — that must be visible
+                    glog.warning("fuse destroy(%s) failed: %r",
+                                 self.mountpoint, e)
 
     # -- node table --
 

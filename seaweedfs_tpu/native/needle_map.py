@@ -42,8 +42,8 @@ class NativeMap:
     def __del__(self):  # best-effort; close() is the real API
         try:
             self.close()
-        except Exception:
-            pass
+        except (OSError, AttributeError):
+            pass  # interpreter teardown: ctypes lib may be half-gone
 
     def set(self, key: int, offset: int, size: int) -> tuple[int, int] | None:
         """Insert/replace; returns the previous (offset, size) or None."""

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from ..filer.entry import Entry
 from ..filer.stream import stream_chunk_views
+from ..util import glog
 from .sink import ReplicationSink
 
 
@@ -95,8 +96,10 @@ class GcsSink(_WholeObjectCloudSink):
         blob = self._bucket.blob(key)
         try:
             blob.delete()
-        except Exception:
-            pass  # absent object: delete is idempotent (gcs_sink.go:66)
+        except Exception as e:
+            # absent object: delete is idempotent (gcs_sink.go:66) —
+            # but an auth/network fault must not hide behind that
+            glog.V(1).infof("gcs delete %s swallowed: %r", key, e)
 
 
 class AzureSink(_WholeObjectCloudSink):
@@ -135,8 +138,9 @@ class AzureSink(_WholeObjectCloudSink):
     def _delete(self, key: str) -> None:
         try:
             self._container.delete_blob(key)
-        except Exception:
-            pass  # idempotent delete (azure_sink.go:77-88)
+        except Exception as e:
+            # idempotent delete (azure_sink.go:77-88), fault still logged
+            glog.V(1).infof("azure delete %s swallowed: %r", key, e)
 
 
 class B2Sink(_WholeObjectCloudSink):
@@ -177,5 +181,7 @@ class B2Sink(_WholeObjectCloudSink):
                 if version.file_name == key:
                     self._client.delete_file_version(version.id_,
                                                      version.file_name)
-        except Exception:
-            pass
+        except Exception as e:
+            # idempotent delete across versions; log so a dead bucket
+            # doesn't masquerade as "nothing to delete"
+            glog.V(1).infof("b2 delete %s swallowed: %r", key, e)

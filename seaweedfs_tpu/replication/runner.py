@@ -13,6 +13,7 @@ import json
 import os
 
 from ..notification.queues import FileQueue, SqliteQueue
+from ..util import tracing
 from .replicator import Replicator
 
 
@@ -62,8 +63,7 @@ async def replicate_from_queue(queue, replicator: Replicator,
         elif isinstance(queue, NotificationInput):
             # broker polls are synchronous network I/O: keep them off
             # the event loop that the source/sink sessions share
-            loop = asyncio.get_running_loop()
-            items = await loop.run_in_executor(None, queue.receive_batch)
+            items = await tracing.run_in_executor(queue.receive_batch)
             batch = [(key, event) for key, event, _ in items]
             tokens = [tok for _, _, tok in items]
         else:
@@ -75,8 +75,7 @@ async def replicate_from_queue(queue, replicator: Replicator,
             applied += 1
         if batch:
             if tokens is not None:
-                await asyncio.get_running_loop().run_in_executor(
-                    None, queue.commit, tokens)
+                await tracing.run_in_executor(queue.commit, tokens)
             else:
                 _save_progress(progress_path, offset)
         if once:

@@ -19,6 +19,7 @@ import aiohttp
 from ..filer.entry import Entry
 from ..filer.filechunks import FileChunk, minus_chunks
 from ..filer.stream import stream_chunk_views
+from ..util import failpoints, tracing
 from ..util.client import WeedClient
 from .source import FilerSource
 
@@ -116,6 +117,7 @@ class FilerSink(ReplicationSink):
         return list(await asyncio.gather(*(one(c) for c in chunks)))
 
     async def _find(self, key: str) -> Entry | None:
+        await failpoints.fail("replication.sink.meta")
         async with self._http.get(
                 tls.url(self.filer_url, "/__api__/lookup"),
                 params={"path": key}) as resp:
@@ -135,6 +137,7 @@ class FilerSink(ReplicationSink):
             "chunks": [c.to_dict() for c in chunks],
             "extended": entry.extended,
         }
+        await failpoints.fail("replication.sink.meta")
         async with self._http.post(
                 tls.url(self.filer_url, "/__api__/entry"),
                 json=payload) as resp:
@@ -166,6 +169,7 @@ class FilerSink(ReplicationSink):
     async def delete_entry(self, key: str, is_directory: bool,
                            delete_chunks: bool) -> None:
         params = {"recursive": "true"} if is_directory else {}
+        await failpoints.fail("replication.sink.meta")
         async with self._http.delete(
                 tls.url(self.filer_url, f"{key}"), params=params) as resp:
             if resp.status not in (200, 204, 404):
@@ -193,6 +197,7 @@ class S3Sink(ReplicationSink):
     async def start(self) -> None:
         self._http = tls.make_session(
             timeout=aiohttp.ClientTimeout(total=60))
+        await failpoints.fail("replication.s3")
         async with self._http.put(
                 f"{self.endpoint}/{self.bucket}") as resp:
             if resp.status not in (200, 409):
@@ -217,6 +222,7 @@ class S3Sink(ReplicationSink):
         if entry.is_directory:
             return  # S3 has no directories
         data = await self._object_bytes(entry)
+        await failpoints.fail("replication.s3")
         async with self._http.put(self._url(key), data=data) as resp:
             if resp.status != 200:
                 raise RuntimeError(f"s3 sink put {key}: {resp.status}")
@@ -230,6 +236,7 @@ class S3Sink(ReplicationSink):
                            delete_chunks: bool) -> None:
         if is_directory:
             return
+        await failpoints.fail("replication.s3")
         async with self._http.delete(self._url(key)) as resp:
             if resp.status not in (200, 204, 404):
                 raise RuntimeError(f"s3 sink delete {key}: {resp.status}")
@@ -251,15 +258,23 @@ class LocalDirSink(ReplicationSink):
     async def create_entry(self, key: str, entry: Entry) -> None:
         p = self._path(key)
         if entry.is_directory:
-            os.makedirs(p, exist_ok=True)
+            await tracing.run_in_executor(
+                lambda: os.makedirs(p, exist_ok=True))
             return
-        os.makedirs(os.path.dirname(p), exist_ok=True)
         buf = bytearray()
         async for block in stream_chunk_views(
                 self.source.client, entry.chunks, 0, entry.size):
             buf.extend(block)
-        with open(p, "wb") as f:
-            f.write(bytes(buf))
+        data = bytes(buf)
+
+        def write() -> None:
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            with open(p, "wb") as f:
+                f.write(data)
+
+        # the runner's loop also carries the source/sink http sessions:
+        # disk writes leave it
+        await tracing.run_in_executor(write)
 
     async def update_entry(self, key: str, old: Entry, new: Entry,
                            delete_chunks: bool) -> bool:
@@ -273,9 +288,10 @@ class LocalDirSink(ReplicationSink):
         p = self._path(key)
         if is_directory:
             import shutil
-            shutil.rmtree(p, ignore_errors=True)
+            await tracing.run_in_executor(
+                lambda: shutil.rmtree(p, ignore_errors=True))
         elif os.path.exists(p):
-            os.unlink(p)
+            await tracing.run_in_executor(os.unlink, p)
 
 
 def _sinks() -> dict:

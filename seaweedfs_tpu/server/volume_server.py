@@ -558,7 +558,6 @@ class VolumeServer:
                 tls.url(others[0]['publicUrl'], f"/{req.match_info['fid']}"))
         from ..stats import metrics
         try:
-            loop = asyncio.get_running_loop()
             t0 = time.perf_counter()
             # hot-needle cache peek: a hit answers on the event loop;
             # misses pay the executor round-trip for disk (and possibly
@@ -667,9 +666,7 @@ class VolumeServer:
                     w = h = 0  # bad params: serve the original (ref parity)
                 mode = req.query.get("mode", "")
                 if w > 0 or h > 0:
-                    body = await loop.run_in_executor(
-                        None,
-                        lambda: resizing.resized(ct, body, w, h, mode))
+                    body = await self._in_executor(lambda: resizing.resized(ct, body, w, h, mode))
                     headers.pop("Etag", None)
         status = 200
         if "Content-Encoding" not in headers:
@@ -901,11 +898,9 @@ class VolumeServer:
         # the EC read path, or a manifest in an EC-encoded volume would
         # orphan every chunk (volume_server_handlers_write.go
         # DeleteHandler)
-        loop = asyncio.get_running_loop()
         if req.query.get("type") != "replicate":
             try:
-                existing = await loop.run_in_executor(
-                    None, lambda: self.store.read_needle(
+                existing = await self._in_executor(lambda: self.store.read_needle(
                         fid.volume_id, fid.key, fid.cookie))
                 if existing.is_chunked_manifest:
                     from ..util.chunked import ChunkManifest
@@ -921,8 +916,7 @@ class VolumeServer:
                 glog.warning("delete %s: manifest cascade skipped: %s",
                              req.match_info["fid"], e)
         try:
-            size = await loop.run_in_executor(
-                None, lambda: self.store.delete_needle(fid.volume_id, n))
+            size = await self._in_executor(lambda: self.store.delete_needle(fid.volume_id, n))
         except NotFound:
             return web.json_response({"error": "volume not found"},
                                      status=404)
@@ -996,11 +990,9 @@ class VolumeServer:
                 return {"fileId": fid_s, "status": 500, "error": str(e)}
             return {"fileId": fid_s, "status": 202, "size": size}
 
-        loop = asyncio.get_running_loop()
         wc = self.worker_ctx
         if wc is None or self._is_worker_hop(req):
-            results = await loop.run_in_executor(
-                None, lambda: [one(f) for f in fids])
+            results = await self._in_executor(lambda: [one(f) for f in fids])
             return web.json_response({"results": results})
         # -workers: a batch spans partitions — split by owning worker,
         # delete the local group here, forward each sibling its group,
@@ -1015,8 +1007,7 @@ class VolumeServer:
             groups.setdefault(idx, []).append(f)
         by_fid: dict[str, dict] = {}
         local = groups.pop(wc.index, [])
-        for r in await loop.run_in_executor(
-                None, lambda: [one(f) for f in local]):
+        for r in await self._in_executor(lambda: [one(f) for f in local]):
             by_fid[r["fileId"]] = r
 
         async def forward(idx: int, group: list) -> None:
@@ -1027,6 +1018,7 @@ class VolumeServer:
             rows = None
             if addr is not None:
                 try:
+                    await failpoints.fail("worker.forward")
                     async with self._http.post(
                             tls.url(addr, "/admin/batch_delete"),
                             json=sub,
@@ -1054,6 +1046,7 @@ class VolumeServer:
     async def _ec_delete_broadcast(self, vid: int, fid: str,
                                    auth: str = "") -> None:
         try:
+            await failpoints.fail("volume.ec_broadcast")
             async with self._http.get(
                     tls.url(self.master_url, "/vol/ec_lookup"),
                     params={"volumeId": str(vid)}) as resp:
@@ -1068,6 +1061,7 @@ class VolumeServer:
 
         async def one(target: str) -> None:
             try:
+                await failpoints.fail("volume.ec_broadcast")
                 async with self._http.delete(
                         tls.url(target, f"/{fid}"),
                         params={"type": "replicate"},
@@ -1169,6 +1163,7 @@ class VolumeServer:
             if addr is None:
                 return
             try:
+                await failpoints.fail("worker.fanout")
                 async with self._http.get(
                         tls.url(addr, path),
                         headers={_wk().WORKER_HEADER: wc.token},
@@ -1217,6 +1212,7 @@ class VolumeServer:
             if addr is None:
                 return
             try:
+                # weedlint: ignore[failpoint-site] this IS the failpoint arming fan-out; a fault injected into arming would leave chaos runs unable to arm sites at all
                 async with self._http.request(
                         req.method, tls.url(addr, "/debug/failpoints"),
                         params=req.query,
@@ -1399,10 +1395,8 @@ class VolumeServer:
         """Load an on-disk volume into the store (VolumeMount)."""
         vid = int(req.query["volume"])
         collection = req.query.get("collection", "")
-        loop = asyncio.get_running_loop()
         try:
-            await loop.run_in_executor(
-                None, lambda: self.store.mount_volume(collection, vid))
+            await self._in_executor(lambda: self.store.mount_volume(collection, vid))
         except VolumeError as e:
             return web.json_response({"error": str(e)}, status=404)
         await self._heartbeat_now()
@@ -1431,24 +1425,32 @@ class VolumeServer:
 
         async def fetch(ext: str) -> str | None:
             try:
+                await failpoints.fail("volume.copy.fetch")
                 async with self._http.get(
                         tls.url(source, "/admin/file"),
                         params={"volume": str(vid), "collection": collection,
                                 "ext": ext}) as resp:
                     if resp.status != 200:
                         return f"fetch {ext}: {resp.status}"
-                    with open(base + ext, "wb") as f:
-                        async for chunk in resp.content.iter_chunked(1 << 20):
-                            f.write(chunk)
+                    # a .dat can be GBs: open/write/close all leave the
+                    # event loop so in-flight reads don't stall behind
+                    # this admin copy
+                    f = await self._in_executor(open, base + ext, "wb")
+                    try:
+                        async for chunk in resp.content.iter_chunked(
+                                1 << 20):
+                            await self._in_executor(f.write, chunk)
+                    finally:
+                        await self._in_executor(f.close)
                     return None
-            except aiohttp.ClientError as e:
+            except (aiohttp.ClientError, OSError) as e:
                 return str(e)
 
         err = await fetch(".idx") or await fetch(".dat")
         if err:
             for ext in (".idx", ".dat"):
                 if os.path.exists(base + ext):
-                    os.remove(base + ext)
+                    await self._in_executor(os.remove, base + ext)
             return web.json_response({"error": err}, status=502)
         return await self.h_volume_mount(req)
 
@@ -1482,14 +1484,12 @@ class VolumeServer:
         resp = web.StreamResponse(
             headers={"Content-Type": "application/octet-stream"})
         await resp.prepare(req)
-        loop = asyncio.get_running_loop()
         # stream record-by-record: each iteration does one short locked
         # read in the executor, so large tails neither hold the volume
         # lock across awaits nor buffer the whole tail in RAM
         it = vb.tail_records(v, since_ns)
         while True:
-            item = await loop.run_in_executor(
-                None, lambda: next(it, None))
+            item = await self._in_executor(lambda: next(it, None))
             if item is None:
                 break
             n, is_delete = item
@@ -1509,7 +1509,6 @@ class VolumeServer:
             return web.json_response({"error": "not found"}, status=404)
         since = v.last_append_at_ns
         applied = 0
-        loop = asyncio.get_running_loop()
         dec = vb.FrameDecoder()
 
         def apply_batch(recs) -> int:
@@ -1523,6 +1522,7 @@ class VolumeServer:
             return len(recs)
 
         try:
+            await failpoints.fail("volume.tail")
             async with self._http.get(
                     tls.url(source, "/admin/volume/tail"),
                     params={"volume": str(vid),
@@ -1535,9 +1535,8 @@ class VolumeServer:
                 async for chunk in resp.content.iter_chunked(1 << 20):
                     recs = dec.feed(chunk)
                     if recs:
-                        applied += await loop.run_in_executor(
-                            None, lambda: apply_batch(recs))
-        except aiohttp.ClientError as e:
+                        applied += await self._in_executor(lambda: apply_batch(recs))
+        except (aiohttp.ClientError, OSError) as e:
             return web.json_response({"error": str(e)}, status=502)
         return web.json_response({"applied": applied})
 
@@ -1554,10 +1553,8 @@ class VolumeServer:
         v = self.store.volumes.get(vid)
         if v is None:
             return web.json_response({"error": "not found"}, status=404)
-        loop = asyncio.get_running_loop()
         try:
-            size = await loop.run_in_executor(
-                None, lambda: volume_tier.tier_upload(
+            size = await self._in_executor(lambda: volume_tier.tier_upload(
                     v, backend_id, keep_local))
         except (BackendError, VolumeError) as e:
             return web.json_response({"error": str(e)}, status=502)
@@ -1571,10 +1568,8 @@ class VolumeServer:
         v = self.store.volumes.get(vid)
         if v is None:
             return web.json_response({"error": "not found"}, status=404)
-        loop = asyncio.get_running_loop()
         try:
-            size = await loop.run_in_executor(
-                None, lambda: volume_tier.tier_download(v))
+            size = await self._in_executor(lambda: volume_tier.tier_download(v))
         except (BackendError, VolumeError) as e:
             return web.json_response({"error": str(e)}, status=502)
         return web.json_response({"downloaded": size})
@@ -1594,8 +1589,7 @@ class VolumeServer:
         v = self.store.volumes.get(vid)
         if v is None:
             return web.json_response({"error": "not found"}, status=404)
-        loop = asyncio.get_running_loop()
-        await loop.run_in_executor(None, lambda: vacuum.compact(v))
+        await self._in_executor(lambda: vacuum.compact(v))
         return web.json_response({"ok": True})
 
     async def h_vacuum_commit(self, req: web.Request) -> web.Response:
@@ -1604,12 +1598,10 @@ class VolumeServer:
         v = self.store.volumes.get(vid)
         if v is None:
             return web.json_response({"error": "not found"}, status=404)
-        loop = asyncio.get_running_loop()
         try:
             # store-level commit: swaps .dat/.idx AND drops this
             # volume's hot-needle cache entries (offsets all moved)
-            await loop.run_in_executor(
-                None, lambda: self.store.commit_compaction(vid))
+            await self._in_executor(lambda: self.store.commit_compaction(vid))
         except vacuum.VacuumError as e:
             return web.json_response({"error": str(e)}, status=500)
         return web.json_response({"ok": True})
@@ -1642,14 +1634,13 @@ class VolumeServer:
         if base is None:
             return web.json_response({"error": f"volume {vid} not found"},
                                      status=404)
-        loop = asyncio.get_running_loop()
 
         def work():
             ecpl.write_ec_files(base,
                                 large_block=self.store.ec_large_block,
                                 small_block=self.store.ec_small_block)
             ecpl.write_sorted_file_from_idx(base)
-        await loop.run_in_executor(None, work)
+        await self._in_executor(work)
         return web.json_response({"ok": True})
 
     async def h_ec_generate_batch(self, req: web.Request) -> web.Response:
@@ -1671,6 +1662,7 @@ class VolumeServer:
                 try:
                     if addr is None:
                         raise OSError(f"worker {idx} unavailable")
+                    await failpoints.fail("worker.forward")
                     async with self._http.post(
                             tls.url(addr, "/admin/ec/generate_batch"),
                             params={"volumes": ",".join(map(str, group)),
@@ -1716,7 +1708,6 @@ class VolumeServer:
                 return web.json_response(
                     {"error": f"volume {vid} not found"}, status=404)
             bases.append(base)
-        loop = asyncio.get_running_loop()
 
         def work():
             ecpl.write_ec_files_batched(
@@ -1724,7 +1715,7 @@ class VolumeServer:
                 small_block=self.store.ec_small_block)
             for base in bases:
                 ecpl.write_sorted_file_from_idx(base)
-        await loop.run_in_executor(None, work)
+        await self._in_executor(work)
         return web.json_response({"ok": True, "volumes": vids})
 
     async def h_ec_rebuild(self, req: web.Request) -> web.Response:
@@ -1735,10 +1726,8 @@ class VolumeServer:
         if base is None:
             return web.json_response({"error": f"ec volume {vid} not found"},
                                      status=404)
-        loop = asyncio.get_running_loop()
         try:
-            rebuilt = await loop.run_in_executor(
-                None, lambda: ecpl.rebuild_ec_files(base))
+            rebuilt = await self._in_executor(lambda: ecpl.rebuild_ec_files(base))
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=500)
         return web.json_response({"rebuilt": rebuilt})
@@ -1755,10 +1744,8 @@ class VolumeServer:
             return web.json_response({"error": f"ec volume {vid} not "
                                       f"mounted"}, status=404)
         window = int(req.query.get("windowMB", 4)) << 20
-        loop = asyncio.get_running_loop()
         try:
-            report = await loop.run_in_executor(
-                None, lambda: ev.verify_parity(window))
+            report = await self._in_executor(lambda: ev.verify_parity(window))
         except (OSError, EcVolumeError) as e:
             return web.json_response({"error": str(e)}, status=500)
         report["volume"] = vid
@@ -1813,6 +1800,7 @@ class VolumeServer:
             exts += [".ecx", ".ecj"]
         for ext in exts:
             try:
+                await failpoints.fail("volume.ec_copy.fetch")
                 async with self._http.get(
                         tls.url(source, "/admin/file"),
                         params={"volume": str(vid),
@@ -1824,10 +1812,15 @@ class VolumeServer:
                         return web.json_response(
                             {"error": f"fetch {ext} from {source}: "
                                       f"{resp.status}"}, status=502)
-                    with open(base + ext, "wb") as f:
-                        async for chunk in resp.content.iter_chunked(1 << 20):
-                            f.write(chunk)
-            except aiohttp.ClientError as e:
+                    # shard files are volume-sized: file I/O off-loop
+                    f = await self._in_executor(open, base + ext, "wb")
+                    try:
+                        async for chunk in resp.content.iter_chunked(
+                                1 << 20):
+                            await self._in_executor(f.write, chunk)
+                    finally:
+                        await self._in_executor(f.close)
+            except (aiohttp.ClientError, OSError) as e:
                 return web.json_response({"error": str(e)}, status=502)
         return web.json_response({"ok": True})
 
@@ -1844,7 +1837,7 @@ class VolumeServer:
             for ext in exts:
                 p = base + ext
                 if os.path.exists(p):
-                    os.remove(p)
+                    await self._in_executor(os.remove, p)
         return web.json_response({"ok": True})
 
     async def h_ec_to_volume(self, req: web.Request) -> web.Response:
@@ -1858,7 +1851,6 @@ class VolumeServer:
         if base is None:
             return web.json_response({"error": f"ec volume {vid} not found"},
                                      status=404)
-        loop = asyncio.get_running_loop()
 
         def work():
             dat_size = ecpl.find_dat_file_size(base)
@@ -1868,7 +1860,7 @@ class VolumeServer:
             ecpl.write_idx_file_from_ec_index(base)
             return dat_size
         try:
-            dat_size = await loop.run_in_executor(None, work)
+            dat_size = await self._in_executor(work)
         except FileNotFoundError as e:
             # a data shard is absent on this node: the caller must gather
             # or rebuild shards 0..9 here first
@@ -1879,9 +1871,7 @@ class VolumeServer:
         """VolumeEcShardRead (volume_grpc_erasure_coding.go:254-320)."""
         q = req.query
         vid = int(q["volume"])
-        loop = asyncio.get_running_loop()
-        data = await loop.run_in_executor(
-            None, lambda: self.store.read_ec_shard_interval(
+        data = await self._in_executor(lambda: self.store.read_ec_shard_interval(
                 vid, int(q["shard"]), int(q["offset"]), int(q["size"])))
         if data is None:
             return web.json_response({"error": "shard not found"},
@@ -1904,7 +1894,6 @@ class VolumeServer:
         resp = web.StreamResponse(
             headers={"Content-Type": "application/x-ndjson"})
         await resp.prepare(req)
-        loop = asyncio.get_running_loop()
         import json as _json
 
         def read_and_query(f: t.FileId) -> list[dict]:
@@ -1917,8 +1906,7 @@ class VolumeServer:
         for fid_str in fids:
             try:
                 fid = self._parse_fid(fid_str)
-                recs = await loop.run_in_executor(
-                    None, lambda: read_and_query(fid))
+                recs = await self._in_executor(lambda: read_and_query(fid))
             except (ValueError, NotFound, AlreadyDeleted, VolumeError,
                     CrcMismatch, gzip.BadGzipFile, OSError, BackendError):
                 continue

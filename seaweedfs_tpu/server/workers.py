@@ -38,7 +38,7 @@ import signal
 import time
 
 from ..security import tls
-from ..util import glog
+from ..util import failpoints, glog
 
 # Shared-secret header marking an intra-host worker-to-worker hop. The
 # token is minted per launch by the supervisor and travels via this
@@ -404,6 +404,7 @@ class AssignAccelerator:
         if target is None:
             return
         try:
+            await failpoints.fail("master.lease")
             async with self._http.get(
                     tls.url(target, "/cluster/assign_state"),
                     params={"collection": collection,
@@ -427,6 +428,7 @@ class AssignAccelerator:
         if target is None:
             return
         try:
+            await failpoints.fail("master.lease")
             async with self._http.get(
                     tls.url(target, "/cluster/seq_lease"),
                     params={"count": str(self.LEASE_BLOCK)},

@@ -11,6 +11,7 @@ import json
 import posixpath
 
 from ..security import tls
+from ..util import tracing
 from .env import CommandEnv
 
 
@@ -135,11 +136,23 @@ async def fs_meta_save(env: CommandEnv, filer: str, path: str,
                        out_file: str) -> dict:
     """Dump the subtree's metadata to JSON-lines
     (fs.meta.save, command_fs_meta_save.go)."""
+    # streamed in batches: the shell shares its loop with the env's
+    # http session (writes must not stall it), and a multi-million
+    # entry namespace must not accumulate in RAM
     n = 0
-    with open(out_file, "w") as f:
+    f = await tracing.run_in_executor(open, out_file, "w")
+    try:
+        batch: list[str] = []
         async for e, _ in _walk(env, filer, path):
-            f.write(json.dumps(e) + "\n")
+            batch.append(json.dumps(e) + "\n")
             n += 1
+            if len(batch) >= 512:
+                lines, batch = batch, []
+                await tracing.run_in_executor(f.writelines, lines)
+        if batch:
+            await tracing.run_in_executor(f.writelines, batch)
+    finally:
+        await tracing.run_in_executor(f.close)
     return {"saved": n, "file": out_file}
 
 
@@ -149,20 +162,30 @@ async def fs_meta_load(env: CommandEnv, filer: str, in_file: str) -> dict:
     restores the namespace (command_fs_meta_load.go semantics)."""
     n = 0
     failures: list[str] = []
-    with open(in_file) as f:
-        for line in f:
-            if not line.strip():
-                continue
-            e = json.loads(line)
-            async with env.http.post(_filer_url(filer, "/__api__/entry"),
-                                     json=e) as resp:
-                if resp.status == 200:
-                    n += 1
-                else:
-                    # a partial restore must never look like success
-                    failures.append(
-                        f"{e.get('FullPath')}: http {resp.status} "
-                        f"{(await resp.text())[:120]}")
+    # bounded batches of lines per executor round-trip: dumps can be
+    # namespace-sized, so neither whole-file buffering nor on-loop reads
+    f = await tracing.run_in_executor(open, in_file)
+    try:
+        while True:
+            lines = await tracing.run_in_executor(f.readlines, 1 << 16)
+            if not lines:
+                break
+            for line in lines:
+                if not line.strip():
+                    continue
+                e = json.loads(line)
+                async with env.http.post(
+                        _filer_url(filer, "/__api__/entry"),
+                        json=e) as resp:
+                    if resp.status == 200:
+                        n += 1
+                    else:
+                        # a partial restore must never look like success
+                        failures.append(
+                            f"{e.get('FullPath')}: http {resp.status} "
+                            f"{(await resp.text())[:120]}")
+    finally:
+        await tracing.run_in_executor(f.close)
     out = {"loaded": n, "failed": len(failures), "file": in_file}
     if failures:
         out["errors"] = failures[:10]

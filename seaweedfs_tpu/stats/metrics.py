@@ -152,17 +152,16 @@ async def push_loop(gateway: str, job: str,
     LOGGED — the first failure and every healthy<->failing transition
     at WARNING/INFO — while the push interval backs off exponentially
     so a long-dead gateway neither floods the log nor gets hammered."""
-    from ..util import glog
+    from ..util import glog, tracing
     if not HAVE_PROMETHEUS or not gateway:
         return
-    loop = asyncio.get_running_loop()
     failing = False
     delay = interval_seconds
     while True:
         try:
-            await loop.run_in_executor(
-                None, lambda: push_to_gateway(gateway, job=job,
-                                              registry=REGISTRY))
+            await tracing.run_in_executor(
+                lambda: push_to_gateway(gateway, job=job,
+                                        registry=REGISTRY))
             if failing:
                 glog.info("metrics push to %s recovered (job=%s)",
                           gateway, job)

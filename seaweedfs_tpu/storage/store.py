@@ -467,6 +467,7 @@ class Store:
         f = ev.shards.get(shard_id)
         if f is None:
             return None
+        failpoints.sync_fail("store.ec_read")
         data = os.pread(f.fileno(), size, offset)
         return data + b"\x00" * (size - len(data))
 

@@ -15,7 +15,7 @@ of failing the read.
 from __future__ import annotations
 
 from ..security import tls
-from . import tracing
+from . import failpoints, tracing
 from .resilience import BreakerRegistry, RetryBudget, RetryPolicy
 from .singleflight import SingleFlight
 
@@ -163,6 +163,7 @@ class WeedClient:
                         self._rotate_seed()
                         continue
                     try:
+                        await failpoints.fail("client.master_get")
                         async with self.http.get(
                                 tls.url(self.master_url, path),
                                 params=params, headers=headers,
@@ -309,6 +310,7 @@ class WeedClient:
                     sp.event("breaker_open", upstream=url)
                     break
                 try:
+                    await failpoints.fail("client.upload")
                     async with self.http.post(
                             tls.url(url, f"/{fid}"), data=data,
                             params=params, headers=headers,
@@ -347,6 +349,13 @@ class WeedClient:
         token = auth or self._mint_jwt(fid)
         if token:
             headers["Authorization"] = f"Bearer {token}"
+        if self.chunk_cache is not None:
+            # same drop-before/drop-after discipline as upload(): a
+            # manifest overwrite of a cached fid must not serve the
+            # pre-overwrite bytes, and a fetch racing the POST's round
+            # trip must not re-pin them afterwards
+            self.chunk_cache.delete(fid)
+        await failpoints.fail("client.upload_manifest")
         async with self.http.post(tls.url(url, f"/{fid}"),
                                   data=manifest.marshal(),
                                   params=params, headers=headers,
@@ -354,6 +363,8 @@ class WeedClient:
             body = await resp.json()
             if resp.status not in (200, 201):
                 raise OperationError(f"upload manifest {fid}: {body}")
+            if self.chunk_cache is not None:
+                self.chunk_cache.delete(fid)
             return body
 
     async def upload_data(self, data: bytes, collection: str = "",
@@ -404,8 +415,7 @@ class WeedClient:
         request on the daemon behind its page faults."""
         cc = self.chunk_cache
         if cc.has_disk:
-            return await asyncio.get_running_loop().run_in_executor(
-                None, cc.get, fid)
+            return await tracing.run_in_executor(cc.get, fid)
         return cc.get(fid)
 
     async def chunk_bytes(self, fid: str, size: int = -1) -> bytes:
@@ -433,8 +443,8 @@ class WeedClient:
             blob = b"".join(parts)
             if cc.has_disk:
                 # mmap writes for disk-routed sizes: executor, not loop
-                await asyncio.get_running_loop().run_in_executor(
-                    None, cc.set_if, fid, blob, token)
+                await tracing.run_in_executor(cc.set_if, fid, blob,
+                                              token)
             else:
                 cc.set_if(fid, blob, token)
             return blob
@@ -510,6 +520,7 @@ class WeedClient:
                         if sent and tries > 1:
                             sp.event("range_resume", at=cur)
                     try:
+                        await failpoints.fail("client.read")
                         async with self.http.get(
                                 url, headers=headers,
                                 timeout=DATA_TIMEOUT) as resp:
@@ -603,6 +614,7 @@ class WeedClient:
                 if token:
                     headers["Authorization"] = f"Bearer {token}"
                 try:
+                    await failpoints.fail("client.delete")
                     async with self.http.delete(
                             tls.url(server, f"/{fid}"),
                             params={"type": "replicate"},
@@ -625,6 +637,7 @@ class WeedClient:
             if self.jwt_key:
                 payload["tokens"] = {f: self._mint_jwt(f) for f in batch}
             try:
+                await failpoints.fail("client.delete")
                 async with self.http.post(
                         tls.url(server, "/admin/batch_delete"),
                         json=payload, timeout=DATA_TIMEOUT) as resp:

@@ -15,7 +15,7 @@ moves and node deaths.
 from __future__ import annotations
 
 from ..security import tls
-from . import glog
+from . import failpoints, glog
 from .resilience import Backoff
 
 import asyncio
@@ -162,6 +162,7 @@ class MasterClient:
                 await asyncio.sleep(backoff.next())
 
     async def _consume_stream(self, master: str) -> None:
+        await failpoints.fail("masterclient.watch")
         async with self._session.get(
                 tls.url(master, "/cluster/watch")) as resp:
             if resp.status != 200:

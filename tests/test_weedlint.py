@@ -1,0 +1,595 @@
+"""Tier-1 gates for the weedlint framework: every rule fires on its
+positive fixture and stays quiet on its negative one, suppressions and
+the baseline round-trip, the checked-in baseline carries no stale or
+unjustified entries, and the enforced tree (seaweedfs_tpu + tools) is
+clean — the acceptance bar for every future PR."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.weedlint import (ALL_RULE_CLASSES, ALL_RULE_IDS,  # noqa: E402
+                            Baseline, lint, make_rules, run_file,
+                            run_paths)
+from tools.weedlint.baseline import DEFAULT_PATH  # noqa: E402
+from tools.weedlint.cli import main as weedlint_main  # noqa: E402
+
+
+def probs(tmp_path, src, name="snippet.py", select=None,
+          check_unused=True):
+    f = tmp_path / name
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(src))
+    rules = make_rules(select=select)
+    return [x for x in run_file(str(f), rules,
+                                check_unused=check_unused)
+            if not x.suppressed]
+
+
+def rule_ids(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------------
+# per-rule positive / negative fixtures
+# ---------------------------------------------------------------------
+
+def test_blocking_io_fires_in_async_def(tmp_path):
+    found = probs(tmp_path, """
+        import os, time
+        async def h(req):
+            time.sleep(0.1)
+            data = os.pread(3, 10, 0)
+            f = open("/tmp/x")
+            return data
+    """, select=["blocking-io"])
+    assert rule_ids(found) == ["blocking-io"] * 3
+
+
+def test_blocking_io_quiet_in_sync_and_executor_thunks(tmp_path):
+    found = probs(tmp_path, """
+        import asyncio, os, time
+        from seaweedfs_tpu.util import tracing
+
+        def sync_helper():
+            time.sleep(0.1)              # sync code: fine
+            return open("/tmp/x")
+
+        async def h(req):
+            await asyncio.sleep(0.1)     # async sleep: fine
+            # a thunk handed to the executor runs OFF the loop
+            return await tracing.run_in_executor(
+                lambda: os.pread(3, 10, 0))
+    """, select=["blocking-io"])
+    assert found == []
+
+
+def test_orphan_task_fires_on_dropped_handle(tmp_path):
+    found = probs(tmp_path, """
+        import asyncio
+        async def go():
+            asyncio.create_task(work())          # dropped
+            _ = asyncio.ensure_future(work())    # throwaway name
+    """, select=["orphan-task"])
+    assert rule_ids(found) == ["orphan-task"] * 2
+
+
+def test_orphan_task_quiet_when_retained_or_awaited(tmp_path):
+    found = probs(tmp_path, """
+        import asyncio
+        async def go(self):
+            t = asyncio.create_task(work())
+            self._tasks.append(asyncio.create_task(work()))
+            await asyncio.create_task(work())
+            return t
+    """, select=["orphan-task"])
+    assert found == []
+
+
+def test_await_in_lock_fires_under_sync_lock(tmp_path):
+    found = probs(tmp_path, """
+        async def h(self):
+            with self._lock:
+                await self.client.upload(b"x")
+    """, select=["await-in-lock"])
+    assert rule_ids(found) == ["await-in-lock"]
+
+
+def test_await_in_lock_quiet_cases(tmp_path):
+    found = probs(tmp_path, """
+        async def ok1(self):
+            async with self._alock:
+                await self.client.upload(b"x")   # async lock: fine
+        async def ok2(self):
+            with self._lock:
+                self.counter += 1                # no await under lock
+        async def ok3(self):
+            with self._lock:
+                async def later():
+                    await work()                 # runs on its own time
+                self.cb = later
+    """, select=["await-in-lock"])
+    assert found == []
+
+
+def test_lock_acquire_fires_on_unprotected_manual_acquire(tmp_path):
+    found = probs(tmp_path, """
+        import asyncio
+        async def h(lock):
+            await lock.acquire()
+            do_work()
+            lock.release()
+    """, select=["lock-acquire"])
+    assert rule_ids(found) == ["lock-acquire"]
+
+
+def test_lock_acquire_quiet_with_finally_and_async_with(tmp_path):
+    found = probs(tmp_path, """
+        import asyncio
+        async def ok1(lock):
+            await lock.acquire()
+            try:
+                do_work()
+            finally:
+                lock.release()
+        async def ok2(lock):
+            try:
+                await lock.acquire()
+                do_work()
+            finally:
+                lock.release()
+        async def ok3(lock):
+            async with lock:
+                do_work()
+    """, select=["lock-acquire"])
+    assert found == []
+
+
+def test_lock_acquire_fires_on_sync_with_over_asyncio_lock(tmp_path):
+    found = probs(tmp_path, """
+        import asyncio
+        class S:
+            def __init__(self):
+                self._mu = asyncio.Lock()
+            def bad(self):
+                with self._mu:
+                    return 1
+    """, select=["lock-acquire"])
+    assert rule_ids(found) == ["lock-acquire"]
+
+
+def test_resource_with_fires_on_leaky_shapes(tmp_path):
+    found = probs(tmp_path, """
+        import aiohttp, socket
+        async def leak1():
+            sess = aiohttp.ClientSession()
+            await sess.get("http://x")
+            await sess.close()               # not exception-safe
+        def leak2(p):
+            return open(p).read()            # unbound chain
+        def leak3():
+            socket.socket()                  # discarded outright
+    """, select=["resource-with"])
+    assert rule_ids(found) == ["resource-with"] * 3
+
+
+def test_resource_with_quiet_on_owned_shapes(tmp_path):
+    found = probs(tmp_path, """
+        import aiohttp, socket
+        async def ok1():
+            async with aiohttp.ClientSession() as sess:
+                await sess.get("http://x")
+        def ok2(p):
+            with open(p) as f:
+                return f.read()
+        def ok3():
+            s = socket.socket()
+            try:
+                s.connect(("h", 1))
+            finally:
+                s.close()
+        def ok4(self):
+            self.sock = socket.socket()      # owner closes later
+        def ok5():
+            s = socket.socket()
+            return s                         # ownership transferred
+        def ok6():
+            s = socket.socket()
+            register(s)                      # handed to another owner
+    """, select=["resource-with"])
+    assert found == []
+
+
+def test_cache_invalidate_fires_on_blind_mutator(tmp_path):
+    found = probs(tmp_path, """
+        class Store:
+            def write_needle(self, vid, n):
+                return self._volume(vid).write(n)
+            def read_needle(self, vid, nid):
+                return self._volume(vid).read(nid)   # reads unchecked
+    """, select=["cache-invalidate"])
+    assert rule_ids(found) == ["cache-invalidate"]
+
+
+def test_cache_invalidate_quiet_with_invalidation_or_delegation(
+        tmp_path):
+    found = probs(tmp_path, """
+        class Store:
+            def write_needle(self, vid, n):
+                off = self._volume(vid).write(n)
+                self.needle_cache.invalidate(vid, n.id)
+                return off
+        class WeedClient:
+            async def upload(self, fid, data):
+                self.chunk_cache.delete(fid)
+                return await self._post(fid, data)
+            async def upload_data(self, data):
+                return await self.upload(self._fid(), data)  # delegates
+    """, select=["cache-invalidate"])
+    assert found == []
+
+
+def test_failpoint_site_fires_in_data_plane_scope(tmp_path):
+    found = probs(tmp_path, """
+        async def replicate(self, url, body):
+            async with self._http.post(url, data=body) as r:
+                return r.status
+    """, name="seaweedfs_tpu/server/newmod.py",
+        select=["failpoint-site"])
+    assert rule_ids(found) == ["failpoint-site"]
+
+
+def test_failpoint_site_quiet_with_site_or_outside_scope(tmp_path):
+    found = probs(tmp_path, """
+        from seaweedfs_tpu.util import failpoints
+        async def replicate(self, url, body):
+            await failpoints.fail("volume.replicate")
+            async with self._http.post(url, data=body) as r:
+                return r.status
+    """, name="seaweedfs_tpu/server/covered.py",
+        select=["failpoint-site"])
+    assert found == []
+    found = probs(tmp_path, """
+        async def fetch(self, url):
+            async with self._http.get(url) as r:    # shell/: no scope
+                return await r.read()
+    """, name="seaweedfs_tpu/shell/helper.py",
+        select=["failpoint-site"])
+    assert found == []
+
+
+def test_executor_ctx_fires_on_raw_run_in_executor(tmp_path):
+    found = probs(tmp_path, """
+        import asyncio
+        async def h(store, vid, nid):
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None, lambda: store.read_needle(vid, nid))
+    """, select=["executor-ctx"])
+    assert rule_ids(found) == ["executor-ctx"]
+
+
+def test_executor_ctx_not_fooled_by_an_argument_named_ctx(tmp_path):
+    """Regression: a thunk argument that merely happens to be called
+    `ctx` is not context propagation."""
+    found = probs(tmp_path, """
+        import asyncio
+        async def h(handler, ctx):
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, handler, ctx)
+    """, select=["executor-ctx"])
+    assert rule_ids(found) == ["executor-ctx"]
+
+
+def test_executor_ctx_quiet_via_helper_or_explicit_copy(tmp_path):
+    found = probs(tmp_path, """
+        import asyncio, contextvars
+        from seaweedfs_tpu.util import tracing
+        async def ok1(store, vid, nid):
+            return await tracing.run_in_executor(
+                store.read_needle, vid, nid)
+        async def ok2(fn):
+            ctx = contextvars.copy_context()
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None,
+                                              lambda: ctx.run(fn))
+    """, select=["executor-ctx"])
+    assert found == []
+
+
+def test_silent_except_and_metrics_rules_still_fire(tmp_path):
+    """The three legacy passes survived the port (deep coverage lives
+    in test_robustness_lint.py against the shim)."""
+    found = probs(tmp_path, """
+        from prometheus_client import Counter
+        C = Counter("wrong_ns_total", "x")
+        def f(sp):
+            try:
+                g()
+            except Exception:
+                pass
+            sp.finish("ok")
+    """, select=["silent-except", "metric-name", "metric-help",
+                 "span-finish"])
+    assert rule_ids(found) == ["metric-name", "silent-except",
+                               "span-finish"]
+
+
+def test_syntax_error_is_a_finding(tmp_path):
+    found = probs(tmp_path, "def broken(:\n")
+    assert rule_ids(found) == ["syntax-error"]
+
+
+# ---------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------
+
+def test_suppression_silences_one_rule_on_one_line(tmp_path):
+    f = tmp_path / "sup.py"
+    f.write_text(textwrap.dedent("""
+        import time
+        async def h():
+            time.sleep(0.1)  # weedlint: ignore[blocking-io] bench driver, loop is otherwise idle
+            time.sleep(0.2)
+    """))
+    findings = run_file(str(f), make_rules())
+    sup = [x for x in findings if x.suppressed]
+    live = [x for x in findings if not x.suppressed]
+    assert len(sup) == 1 and sup[0].rule == "blocking-io"
+    assert sup[0].suppress_reason.startswith("bench driver")
+    assert rule_ids(live) == ["blocking-io"]     # the line below
+
+
+def test_suppression_on_own_line_covers_next_line(tmp_path):
+    f = tmp_path / "sup2.py"
+    f.write_text(textwrap.dedent("""
+        def f():
+            try:
+                g()
+            # weedlint: ignore[silent-except] probe loop, retry counter is the signal
+            except Exception:
+                pass
+    """))
+    findings = run_file(str(f), make_rules())
+    assert [x.rule for x in findings if not x.suppressed] == []
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    f = tmp_path / "noreason.py"
+    f.write_text("import time\n"
+                 "async def h():\n"
+                 "    time.sleep(1)  # weedlint: ignore[blocking-io]\n")
+    findings = run_file(str(f), make_rules())
+    assert "suppress-format" in rule_ids(findings)
+    # and the suppression does NOT take effect
+    assert any(x.rule == "blocking-io" and not x.suppressed
+               for x in findings)
+
+
+def test_unused_suppression_is_a_finding(tmp_path):
+    f = tmp_path / "unused.py"
+    f.write_text("x = 1  # weedlint: ignore[blocking-io] leftover\n")
+    findings = run_file(str(f), make_rules())
+    assert rule_ids(findings) == ["unused-suppression"]
+    # ...but not when a rule subset runs (--select), where the rule a
+    # suppression targets may simply not be loaded
+    findings = run_file(str(f), make_rules(select=["silent-except"]),
+                        check_unused=False)
+    assert findings == []
+
+
+def test_suppression_grammar_in_docstring_is_ignored(tmp_path):
+    f = tmp_path / "doc.py"
+    f.write_text('"""docs: use `# weedlint: ignore[rule-id] reason`."""\n'
+                 "x = 1\n")
+    assert run_file(str(f), make_rules()) == []
+
+
+# ---------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------
+
+BAD_SRC = textwrap.dedent("""
+    import time
+    async def h():
+        time.sleep(0.5)
+""")
+
+
+def test_baseline_round_trip_and_staleness(tmp_path):
+    mod = tmp_path / "legacy.py"
+    mod.write_text(BAD_SRC)
+    bl_path = tmp_path / "baseline.json"
+
+    findings = run_paths([str(mod)], make_rules())
+    bl = Baseline.from_findings(findings, path=str(bl_path))
+    for e in bl.entries:
+        e.justification = "grandfathered: fixed in the next PR"
+    bl.save()
+
+    # round-trip: the grandfathered finding no longer gates
+    result = lint([str(mod)], baseline_path=str(bl_path))
+    assert result.problems == [] and result.stale == []
+    assert result.ok
+    assert [f.baselined for f in result.findings] == [True]
+
+    # the offending line moves but stays identical -> still matched
+    mod.write_text("\n\n" + BAD_SRC)
+    result = lint([str(mod)], baseline_path=str(bl_path))
+    assert result.problems == [] and result.stale == []
+
+    # the bug gets FIXED -> the entry is stale and the tree fails
+    mod.write_text("import asyncio\n"
+                   "async def h():\n"
+                   "    await asyncio.sleep(0.5)\n")
+    result = lint([str(mod)], baseline_path=str(bl_path))
+    assert result.problems == []
+    assert len(result.stale) == 1
+    assert not result.ok
+
+
+def test_syntax_error_is_never_baselineable(tmp_path):
+    """A baselined syntax-error (key code='') would mask every future
+    parse failure in the file — a file no rule ever scanned would
+    lint clean."""
+    mod = tmp_path / "broken.py"
+    mod.write_text("def broken(:\n")
+    findings = run_paths([str(mod)], make_rules())
+    bl = Baseline.from_findings(findings)
+    assert bl.entries == []
+    # even a hand-written entry is ignored at apply time
+    from tools.weedlint.baseline import BaselineEntry
+    forced = Baseline([BaselineEntry(findings[0].rel, "syntax-error",
+                                     "", "sneaky")])
+    forced.apply(findings)
+    assert not findings[0].baselined
+
+
+def test_write_baseline_scoped_run_preserves_other_entries(tmp_path,
+                                                           capsys):
+    """--write-baseline over a subset of paths/rules must not wipe
+    grandfathered entries (and justifications) it never re-checked."""
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text(BAD_SRC)
+    b.write_text(BAD_SRC)
+    bl_path = tmp_path / "bl.json"
+    assert weedlint_main([str(a), str(b), "--baseline", str(bl_path),
+                          "--write-baseline"]) == 0
+    capsys.readouterr()
+    bl = Baseline.load(str(bl_path))
+    assert len(bl.entries) == 2
+    for e in bl.entries:
+        e.justification = "reviewed"
+    bl.save()
+    # scoped rerun over a.py only: b.py's entry must survive untouched
+    assert weedlint_main([str(a), "--baseline", str(bl_path),
+                          "--write-baseline"]) == 0
+    capsys.readouterr()
+    bl2 = Baseline.load(str(bl_path))
+    assert len(bl2.entries) == 2
+    assert all(e.justification == "reviewed" for e in bl2.entries)
+
+
+def test_await_in_lock_not_fooled_by_block_like_names(tmp_path):
+    """Regression: `block`/`clock` context managers are not locks."""
+    found = probs(tmp_path, """
+        async def ok(self):
+            with self.datablock:
+                await work()
+        async def bad(self, rlock):
+            with rlock:
+                await work()
+    """, select=["await-in-lock"])
+    assert [f.line for f in found] == [6]
+
+
+def test_baseline_entry_requires_justification(tmp_path):
+    bl_path = tmp_path / "baseline.json"
+    bl_path.write_text(json.dumps({"version": 1, "entries": [
+        {"path": "x.py", "rule": "blocking-io",
+         "code": "time.sleep(1)", "justification": ""}]}))
+    mod = tmp_path / "x.py"
+    mod.write_text("x = 1\n")
+    result = lint([str(mod)], baseline_path=str(bl_path))
+    assert result.baseline_errors and not result.ok
+
+
+@pytest.fixture(scope="module")
+def tree_result():
+    """One full-tree lint shared by the enforcement gates below — the
+    most expensive operation in this file, computed once."""
+    return lint([os.path.join(REPO, "seaweedfs_tpu"),
+                 os.path.join(REPO, "tools")],
+                baseline_path=DEFAULT_PATH)
+
+
+def test_checked_in_baseline_has_no_stale_or_unjustified_entries(
+        tree_result):
+    """The real acceptance gate: the committed baseline must only
+    carry entries that (a) still match a live finding and (b) say why
+    they are acceptable."""
+    assert tree_result.stale == [], \
+        f"stale baseline entries: " \
+        f"{[e.render() for e in tree_result.stale]}"
+    assert tree_result.baseline_errors == []
+    bl = Baseline.load(DEFAULT_PATH)
+    assert all(e.justification for e in bl.entries)
+
+
+# ---------------------------------------------------------------------
+# the enforced tree + CLI surface
+# ---------------------------------------------------------------------
+
+def test_enforced_tree_is_clean(tree_result):
+    """`python -m tools.weedlint seaweedfs_tpu tools` exits 0 — every
+    rule, whole package, suppressions/baseline applied."""
+    assert tree_result.problems == [], "\n".join(
+        f.render() for f in tree_result.problems)
+    assert tree_result.ok
+
+
+def test_tests_tree_runs_in_report_only_mode():
+    """tests/ is wired report-only: the lint must run to completion
+    over it (exit 0 via --report-only regardless of findings)."""
+    rc = weedlint_main([os.path.join(REPO, "tests"), "--report-only",
+                        "--no-baseline"])
+    assert rc == 0
+
+
+def test_cli_json_output(tmp_path, capsys):
+    mod = tmp_path / "m.py"
+    mod.write_text(BAD_SRC)
+    rc = weedlint_main([str(mod), "--format", "json", "--no-baseline"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["ok"] is False
+    assert out["summary"] == {"blocking-io": 1}
+    assert out["findings"][0]["rule"] == "blocking-io"
+    assert out["findings"][0]["line"] == 4
+
+
+def test_cli_rule_selection_and_unknown_rule(tmp_path, capsys):
+    mod = tmp_path / "m.py"
+    mod.write_text(BAD_SRC)
+    rc = weedlint_main([str(mod), "--select", "silent-except",
+                        "--no-baseline"])
+    capsys.readouterr()
+    assert rc == 0                       # blocking-io not selected
+    rc = weedlint_main([str(mod), "--select", "no-such-rule"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_list_rules(capsys):
+    assert weedlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ALL_RULE_IDS:
+        assert rule_id in out
+
+
+def test_module_entrypoint_runs():
+    """`python -m tools.weedlint` is the documented invocation."""
+    p = subprocess.run(
+        [sys.executable, "-m", "tools.weedlint", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0
+    assert "blocking-io" in p.stdout
+
+
+def test_rule_catalog_is_documented():
+    """STATIC_ANALYSIS.md documents every registered rule id, and
+    every rule carries the metadata the catalog is built from."""
+    doc = open(os.path.join(REPO, "STATIC_ANALYSIS.md"),
+               encoding="utf-8").read()
+    for cls in ALL_RULE_CLASSES:
+        assert cls.id and cls.title and cls.rationale and cls.fix, cls
+        assert f"`{cls.id}`" in doc, \
+            f"rule {cls.id} missing from STATIC_ANALYSIS.md"

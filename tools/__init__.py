@@ -1,0 +1,1 @@
+# Makes `python -m tools.weedlint` resolvable from the repo root.

@@ -240,7 +240,7 @@ async def run(args) -> int:
                     "-mdir", os.path.join(tmp, "m"),
                     "-volumeSizeLimitMB", "8", "-pulseSeconds", "1",
                     "-defaultReplication", "001")
-        time.sleep(2)
+        await asyncio.sleep(2)
         for i in range(n_servers):
             procs.spawn("volume", "-port", str(BASE_PORT + 1 + i),
                         "-dir", os.path.join(tmp, f"v{i}"),
@@ -334,14 +334,21 @@ async def run(args) -> int:
         return 0 if ok else 1
     finally:
         procs.kill_all()
-        if args.json:
-            with open(args.json, "w") as f:
-                json.dump(report, f, indent=2)
+
+        def teardown() -> None:
+            if args.json:
+                with open(args.json, "w") as f:
+                    json.dump(report, f, indent=2)
+            if not args.keep:
+                import shutil
+                shutil.rmtree(tmp, ignore_errors=True)
+
+        # teardown I/O off the loop: pending client tasks may still be
+        # draining their cancellations on it
+        from seaweedfs_tpu.util import tracing
+        await tracing.run_in_executor(teardown)
         if args.keep:
             print("logs under", tmp)
-        else:
-            import shutil
-            shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main() -> int:

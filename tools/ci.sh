@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# One-command gate: weedlint (enforced tree, JSON-consumed) +
+# weedlint over tests/ (report-only) + the tier-1 test suite.
+# Usage: tools/ci.sh [extra pytest args]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== weedlint: enforced tree (seaweedfs_tpu tools) =="
+WL_JSON=$(mktemp)
+python -m tools.weedlint seaweedfs_tpu tools --format json > "$WL_JSON"
+wl_rc=$?
+python - "$WL_JSON" <<'PY'
+import json, sys
+r = json.load(open(sys.argv[1]))
+gating = [f for f in r["findings"]
+          if not f["suppressed"] and not f["baselined"]]
+for f in gating:
+    print(f"  {f['path']}:{f['line']}: [{f['rule']}] {f['message']}")
+for e in r["stale_baseline"]:
+    print(f"  stale baseline entry: {e['path']} [{e['rule']}] "
+          f"{e['code']!r}")
+for msg in r["baseline_errors"]:
+    print(f"  {msg}")
+if r["summary"]:
+    counts = " ".join(f"{k}={v}" for k, v in r["summary"].items())
+    print(f"  {len(gating)} new finding(s): {counts}")
+else:
+    print("  clean")
+PY
+rm -f "$WL_JSON"
+if [ "$wl_rc" -ne 0 ]; then
+    echo "weedlint: FAILED (new findings — fix, suppress with a"
+    echo "reason, or baseline with a justification; see"
+    echo "STATIC_ANALYSIS.md)"
+    exit "$wl_rc"
+fi
+
+echo "== weedlint: tests/ (report-only) =="
+python -m tools.weedlint tests --report-only --no-baseline | tail -n 1
+
+echo "== tier-1 tests =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly "$@"

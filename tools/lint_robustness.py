@@ -1,162 +1,61 @@
-"""AST lint: robustness + observability hygiene.
+"""Back-compat shim over tools/weedlint/ (the original 3-pass lint
+grew into the multi-pass framework there — see STATIC_ANALYSIS.md).
 
-Three passes:
-
-1. Silent broad exceptions — any ``except`` handler that (a) catches
-   ``Exception`` / ``BaseException`` or is a bare ``except:``, AND (b)
-   whose body is only ``pass`` / ``continue`` — the shape that turns
-   real faults invisible. Narrow handlers may still swallow (often
-   correct: idempotent deletes, probe loops); broad ones must log.
-2. Metrics hygiene — every ``Counter``/``Gauge``/``Histogram``
-   construction must use a ``SeaweedFS_``-prefixed lowercase-starting
-   name (the registry's one namespace) and carry non-empty help text.
-3. Span hygiene — every explicit tracing ``<span>.finish(...)`` call
-   (on a name that looks like a span: ``sp``/``rsp``/``span``/
-   ``*_span``/``*_sp``) must sit inside a ``finally`` block, so an
-   exception on any path can never leak an unfinished span out of the
-   in-flight table. ``with tracing.start(...)`` needs no finish and
-   is exempt by construction.
-
-Run as a tier-1 test (tests/test_robustness_lint.py) over
-``seaweedfs_tpu/server/`` (+ util, master, stats) so the data plane
-can never regress, or by hand over any path:
+Kept because CHANGES.md, ROBUSTNESS.md and muscle memory reference
+this path. It runs exactly the original three passes (silent broad
+exceptions, metrics hygiene, span-finish-in-finally) via the shared
+weedlint driver and keeps the original string-list API:
 
     python tools/lint_robustness.py [path ...]
+
+For everything else — the asyncio/resource/cache rules, suppressions,
+baseline, JSON — use ``python -m tools.weedlint``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+try:
+    from tools.weedlint import LEGACY_RULE_IDS, make_rules, run_paths
+except ImportError:                      # run as a script from tools/
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from weedlint import LEGACY_RULE_IDS, make_rules, run_paths
+
 DEFAULT_PATHS = [os.path.join(REPO, "seaweedfs_tpu", "server"),
                  os.path.join(REPO, "seaweedfs_tpu", "stats")]
 
-BROAD = {"Exception", "BaseException"}
 
-METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
-# SeaweedFS_ prefix then a lowercase-led snake-ish name; interior
-# camelCase segments are allowed (the reference's own idiom:
-# SeaweedFS_volumeServer_request_total)
-METRIC_NAME_RE = re.compile(r"^SeaweedFS_[a-z][A-Za-z0-9_]*$")
-SPAN_NAME_RE = re.compile(r"^(sp|rsp|span|.*_span|.*_sp)$")
-
-
-def _is_broad(handler: ast.ExceptHandler) -> bool:
-    t = handler.type
-    if t is None:
-        return True                          # bare except:
-    names = t.elts if isinstance(t, ast.Tuple) else [t]
-    for n in names:
-        if isinstance(n, ast.Name) and n.id in BROAD:
-            return True
-        if isinstance(n, ast.Attribute) and n.attr in BROAD:
-            return True
-    return False
-
-
-def _is_silent(handler: ast.ExceptHandler) -> bool:
-    return all(isinstance(s, (ast.Pass, ast.Continue))
-               for s in handler.body)
-
-
-def _metric_problems(path: str, node: ast.Call) -> list[str]:
-    """Pass 2: metrics hygiene on Counter/Gauge/Histogram calls."""
-    func = node.func
-    name = func.id if isinstance(func, ast.Name) else (
-        func.attr if isinstance(func, ast.Attribute) else "")
-    if name not in METRIC_CTORS or len(node.args) < 1:
-        return []
-    problems = []
-    first = node.args[0]
-    if isinstance(first, ast.Constant) and isinstance(first.value, str):
-        if not METRIC_NAME_RE.match(first.value):
-            problems.append(
-                f"{path}:{node.lineno}: metric name {first.value!r} "
-                f"must match SeaweedFS_[a-z]... (one registry "
-                f"namespace, lowercase-led)")
-    help_arg = node.args[1] if len(node.args) > 1 else None
-    if help_arg is None or (isinstance(help_arg, ast.Constant)
-                            and not str(help_arg.value or "").strip()):
-        problems.append(
-            f"{path}:{node.lineno}: metric {name} needs non-empty "
-            f"help text")
-    return problems
-
-
-def _finally_calls(tree: ast.AST) -> set[int]:
-    """ids of every Call node located inside some `finally` block."""
-    inside: set[int] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Try) and node.finalbody:
-            for stmt in node.finalbody:
-                for sub in ast.walk(stmt):
-                    if isinstance(sub, ast.Call):
-                        inside.add(id(sub))
-    return inside
-
-
-def _span_finish_problem(path: str, node: ast.Call,
-                         in_finally: set[int]) -> list[str]:
-    """Pass 3: span.finish() must be exception-safe (in a finally)."""
-    func = node.func
-    if not (isinstance(func, ast.Attribute) and func.attr == "finish"
-            and isinstance(func.value, ast.Name)
-            and SPAN_NAME_RE.match(func.value.id)):
-        return []
-    if id(node) in in_finally:
-        return []
-    return [f"{path}:{node.lineno}: span {func.value.id}.finish() "
-            f"outside a finally — an exception path would leak the "
-            f"span (use `with` or move the finish into finally)"]
+def _findings(paths: list[str]):
+    rules = make_rules(select=LEGACY_RULE_IDS)
+    return [f for f in run_paths(paths, rules, check_unused=False)
+            if not f.suppressed]
 
 
 def lint_file(path: str) -> list[str]:
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
-    problems = []
-    in_finally = _finally_calls(tree)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
-                and _is_silent(node):
-            what = "bare except" if node.type is None \
-                else "except Exception"
-            problems.append(
-                f"{path}:{node.lineno}: silent {what}: pass — narrow "
-                f"the exception type and/or glog the fault")
-        elif isinstance(node, ast.Call):
-            problems += _metric_problems(path, node)
-            problems += _span_finish_problem(path, node, in_finally)
-    return problems
+    return [f"{f.path}:{f.line}: {f.message}" for f in _findings([path])]
 
 
 def lint_paths(paths: list[str]) -> list[str]:
-    problems: list[str] = []
-    for p in paths:
-        if os.path.isfile(p):
-            problems += lint_file(p)
-            continue
-        for root, _dirs, files in os.walk(p):
-            for name in sorted(files):
-                if name.endswith(".py"):
-                    problems += lint_file(os.path.join(root, name))
-    return problems
+    return [f"{f.path}:{f.line}: {f.message}" for f in _findings(paths)]
 
 
 def main(argv: list[str]) -> int:
-    paths = argv or DEFAULT_PATHS
-    problems = lint_paths(paths)
-    for p in problems:
-        print(p)
-    if problems:
-        print(f"{len(problems)} silent broad exception handler(s)")
+    findings = _findings(argv or DEFAULT_PATHS)
+    for f in findings:
+        print(f"{f.path}:{f.line}: {f.message}")
+    if findings:
+        # summary counts per rule — the old single-line summary called
+        # every finding a "silent broad exception handler" even when it
+        # was a metric/span problem
+        counts: dict[str, int] = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        parts = " ".join(f"{r}={n}" for r, n in sorted(counts.items()))
+        print(f"{len(findings)} finding(s): {parts}")
         return 1
     print("robustness lint: clean")
     return 0

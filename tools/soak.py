@@ -192,6 +192,7 @@ async def scenario_vacuum_race(tmp: str) -> int:
                         try:
                             await c.delete_fids([victim])
                             del payloads[victim]
+                        # weedlint: ignore[silent-except] churn driver: armed failpoints make deletes fail by design; the byte-verify pass catches real loss
                         except Exception:  # noqa: BLE001
                             pass
 
@@ -256,7 +257,7 @@ async def scenario_failover(tmp: str) -> int:
                         "-peers", peers, "-pulseSeconds", "1",
                         "-sequencer",
                         f"file:{os.path.join(procs.tmp, f'seq{i}')}")
-        time.sleep(4)
+        await asyncio.sleep(4)
         for i in range(2):
             procs.spawn("volume", "-port", str(port0 + 10 + i),
                         "-dir", os.path.join(procs.tmp, f"v{i}"),
@@ -352,13 +353,14 @@ class PairProxy:
                         break
                     b.write(d)
                     await b.drain()
+            # weedlint: ignore[silent-except] chaos TCP proxy: severed/reset pipes are this tool's purpose, any stream error just ends the pipe
             except Exception:  # noqa: BLE001
                 pass
             finally:
                 try:
                     b.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except OSError:
+                    pass  # peer already gone
 
         await asyncio.gather(pipe(r, tw), pipe(tr, w),
                              return_exceptions=True)
@@ -554,7 +556,7 @@ async def scenario_workers(tmp: str) -> int:
         procs.spawn("master", "-port", str(port0),
                     "-mdir", os.path.join(procs.tmp, "m"),
                     "-volumeSizeLimitMB", "8", "-pulseSeconds", "1")
-        time.sleep(2)
+        await asyncio.sleep(2)
         vport = port0 + 1
         procs.spawn("volume", "-port", str(vport),
                     "-dir", os.path.join(procs.tmp, "v0"),
@@ -669,7 +671,7 @@ async def scenario_cache_churn(tmp: str) -> int:
         procs.spawn("master", "-port", str(port0),
                     "-mdir", os.path.join(procs.tmp, "m"),
                     "-volumeSizeLimitMB", "8", "-pulseSeconds", "1")
-        time.sleep(2)
+        await asyncio.sleep(2)
         vport = port0 + 1
         procs.spawn("volume", "-port", str(vport),
                     "-dir", os.path.join(procs.tmp, "v"),

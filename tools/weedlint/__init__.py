@@ -1,0 +1,42 @@
+"""weedlint — whole-tree static analysis for asyncio correctness,
+resource safety, observability hygiene, and cache/failpoint
+discipline.
+
+Grown from tools/lint_robustness.py (PR-2's 3-pass, 167-line lint)
+into a framework: shared single-walk visitor driver, per-line
+suppression comments with mandatory reasons, a checked-in baseline
+for grandfathered findings, rule selection, and JSON output. See
+STATIC_ANALYSIS.md for the rule catalog and how to add a pass.
+
+    python -m tools.weedlint seaweedfs_tpu tools
+    python -m tools.weedlint --list-rules
+    python -m tools.weedlint tests --report-only
+"""
+
+from __future__ import annotations
+
+from .baseline import Baseline, BaselineEntry
+from .core import Finding, Rule, run_file, run_paths
+from .rules import (ALL_RULE_CLASSES, ALL_RULE_IDS, LEGACY_RULE_IDS,
+                    META_RULE_IDS, make_rules)
+
+__all__ = [
+    "Baseline", "BaselineEntry", "Finding", "Rule", "run_file",
+    "run_paths", "ALL_RULE_CLASSES", "ALL_RULE_IDS",
+    "LEGACY_RULE_IDS", "META_RULE_IDS", "make_rules", "lint",
+]
+
+
+def lint(paths, *, select=None, ignore=None, baseline_path=None,
+         check_unused=None):
+    """One-call API used by tests, the CI gate and the back-compat
+    shim: lint `paths`, apply the baseline, return a LintResult."""
+    from .cli import LintResult, apply_baseline
+    rules = make_rules(select, ignore)
+    if check_unused is None:
+        check_unused = not select and not ignore
+    findings = run_paths(list(paths), rules, check_unused=check_unused)
+    baseline, stale, format_errors = apply_baseline(
+        findings, baseline_path)
+    return LintResult(findings=findings, stale=stale,
+                      baseline_errors=format_errors)

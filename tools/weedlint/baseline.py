@@ -1,0 +1,125 @@
+"""Checked-in baseline of grandfathered findings.
+
+The baseline lets a new rule land with enforcement ON while old,
+reviewed findings are carried explicitly instead of silently: each
+entry names the repo-relative path, the rule id, the exact source
+line it excuses (so line-number drift doesn't rot it, but any edit to
+the offending line re-opens the finding), and a mandatory written
+justification.
+
+Staleness is an error by design: an entry whose finding no longer
+exists means somebody fixed the bug — the entry must be deleted in
+the same PR, and tests/test_weedlint.py enforces that the checked-in
+file never carries dead weight.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+DEFAULT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "baseline.json")
+
+
+class BaselineEntry:
+    __slots__ = ("path", "rule", "code", "justification", "hits")
+
+    def __init__(self, path: str, rule: str, code: str,
+                 justification: str):
+        self.path = path
+        self.rule = rule
+        self.code = code
+        self.justification = justification
+        self.hits = 0
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.path, self.rule, self.code)
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "rule": self.rule, "code": self.code,
+                "justification": self.justification}
+
+    def render(self) -> str:
+        return f"{self.path} [{self.rule}] {self.code!r}"
+
+
+class Baseline:
+    def __init__(self, entries: list[BaselineEntry] | None = None,
+                 path: str | None = None):
+        self.path = path
+        self.entries = entries or []
+        self.format_errors: list[str] = []
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path=path)
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        bl = cls(path=path)
+        for i, e in enumerate(data.get("entries", [])):
+            entry = BaselineEntry(e.get("path", ""), e.get("rule", ""),
+                                  e.get("code", ""),
+                                  str(e.get("justification", "")).strip())
+            if not entry.justification:
+                bl.format_errors.append(
+                    f"baseline entry #{i} ({entry.render()}) has no "
+                    f"justification — every grandfathered finding "
+                    f"must say why it is acceptable")
+            bl.entries.append(entry)
+        return bl
+
+    def save(self, path: str | None = None) -> None:
+        path = path or self.path or DEFAULT_PATH
+        data = {"version": 1,
+                "entries": [e.to_dict() for e in sorted(
+                    self.entries, key=lambda e: e.key)]}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    # findings the baseline must never absorb: the meta-rules, and
+    # syntax-error (its code key is always '' — one baselined entry
+    # would mask every future syntax error in the file, i.e. a file no
+    # rule ever scanned would lint clean)
+    UNBASELINEABLE = ("suppress-format", "unused-suppression",
+                      "syntax-error")
+
+    def apply(self, findings) -> None:
+        """Mark findings covered by an entry. One entry absorbs every
+        finding with its (path, rule, code) key — a grandfathered
+        shape repeated on N lines of one file is one reviewed fact."""
+        index: dict[tuple[str, str, str], BaselineEntry] = {
+            e.key: e for e in self.entries}
+        for f in findings:
+            if f.suppressed or f.rule in self.UNBASELINEABLE:
+                continue
+            e = index.get((f.rel, f.rule, f.code))
+            if e is not None:
+                f.baselined = True
+                e.hits += 1
+
+    def stale(self) -> list[BaselineEntry]:
+        return [e for e in self.entries if e.hits == 0]
+
+    @classmethod
+    def from_findings(cls, findings, *, old: "Baseline | None" = None,
+                      path: str | None = None) -> "Baseline":
+        """Build a baseline from current unsuppressed findings,
+        carrying justifications over from `old` where keys match; new
+        entries get a TODO the format check will reject until a human
+        writes the reason."""
+        carried = {e.key: e.justification for e in old.entries} if old \
+            else {}
+        seen: dict[tuple[str, str, str], BaselineEntry] = {}
+        for f in findings:
+            if f.suppressed or f.rule in cls.UNBASELINEABLE:
+                continue
+            key = (f.rel, f.rule, f.code)
+            if key not in seen:
+                seen[key] = BaselineEntry(
+                    f.rel, f.rule, f.code,
+                    carried.get(key, ""))
+        return cls(list(seen.values()), path=path)

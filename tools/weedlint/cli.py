@@ -1,0 +1,193 @@
+"""weedlint CLI: rule selection, text/JSON output, baseline
+management, per-rule summary, exit-code policy.
+
+Exit codes: 0 clean (or --report-only), 1 findings / stale baseline /
+format errors, 2 usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+
+from . import baseline as baseline_mod
+from .baseline import Baseline
+from .core import Finding
+from .rules import ALL_RULE_CLASSES, make_rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_PATHS = ["seaweedfs_tpu", "tools"]
+
+
+@dataclass
+class LintResult:
+    findings: list = field(default_factory=list)
+    stale: list = field(default_factory=list)
+    baseline_errors: list = field(default_factory=list)
+
+    @property
+    def problems(self) -> list[Finding]:
+        """Findings that actually gate: not suppressed, not
+        grandfathered."""
+        return [f for f in self.findings
+                if not f.suppressed and not f.baselined]
+
+    def summary(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for f in self.problems:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return dict(sorted(counts.items()))
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems and not self.stale \
+            and not self.baseline_errors
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "ok": self.ok,
+            "findings": [f.to_dict() for f in self.findings],
+            "summary": self.summary(),
+            "stale_baseline": [e.to_dict() for e in self.stale],
+            "baseline_errors": list(self.baseline_errors),
+        }
+
+
+def apply_baseline(findings, baseline_path):
+    """Load + apply the baseline (None = the checked-in default;
+    '' / '-' = none). Returns (baseline, stale_entries, errors)."""
+    if baseline_path in ("", "-"):
+        return None, [], []
+    path = baseline_path or baseline_mod.DEFAULT_PATH
+    bl = Baseline.load(path)
+    bl.apply(findings)
+    return bl, bl.stale(), list(bl.format_errors)
+
+
+def _print_rules() -> None:
+    for c in ALL_RULE_CLASSES:
+        print(f"{c.id}: {c.title}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.weedlint",
+        description="whole-tree static analysis for asyncio "
+                    "correctness, resource safety and invalidation "
+                    "discipline (see STATIC_ANALYSIS.md)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help=f"files/dirs to lint (default: "
+                        f"{' '.join(DEFAULT_PATHS)})")
+    p.add_argument("--select", default="",
+                   help="comma-separated rule ids to run (default all)")
+    p.add_argument("--ignore", default="",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--format", choices=("text", "json"),
+                   default="text")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="baseline file (default "
+                        "tools/weedlint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline entirely")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from current findings "
+                        "(carries existing justifications; new "
+                        "entries need one written before the tree "
+                        "passes)")
+    p.add_argument("--report-only", action="store_true",
+                   help="print findings but always exit 0 (tests/ "
+                        "runs in this mode)")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="also print suppressed/baselined findings")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        _print_rules()
+        return 0
+    paths = args.paths or [os.path.join(REPO, p)
+                           for p in DEFAULT_PATHS]
+    select = [s for s in args.select.split(",") if s]
+    ignore = [s for s in args.ignore.split(",") if s]
+    try:
+        rules = make_rules(select or None, ignore or None)
+    except ValueError as e:
+        print(f"weedlint: {e}", file=sys.stderr)
+        return 2
+    from .core import run_paths
+    check_unused = not select and not ignore
+    findings = run_paths(paths, rules, check_unused=check_unused)
+
+    baseline_path = "-" if args.no_baseline else args.baseline
+    if args.write_baseline:
+        path = args.baseline or baseline_mod.DEFAULT_PATH
+        old = Baseline.load(path) if os.path.exists(path) else None
+        bl = Baseline.from_findings(findings, old=old, path=path)
+        if old is not None:
+            # a scoped run (subset of paths / --select) must not wipe
+            # entries it never re-checked: carry over every old entry
+            # outside this run's scope, justification intact
+            from .core import relpath
+            scanned = [relpath(p) for p in paths]
+            run_rules = {r.id for r in rules}
+            have = {e.key for e in bl.entries}
+            for e in old.entries:
+                in_paths = any(rp in ("", ".") or e.path == rp
+                               or e.path.startswith(rp + "/")
+                               for rp in scanned)
+                if (e.rule not in run_rules or not in_paths) \
+                        and e.key not in have:
+                    bl.entries.append(e)
+        bl.save()
+        missing = sum(1 for e in bl.entries if not e.justification)
+        print(f"wrote {len(bl.entries)} baseline entr"
+              f"{'y' if len(bl.entries) == 1 else 'ies'} to {path}"
+              + (f" ({missing} need a justification written before "
+                 f"the tree passes)" if missing else ""))
+        return 0
+
+    _, stale, errors = apply_baseline(findings, baseline_path)
+    result = LintResult(findings=findings, stale=stale,
+                        baseline_errors=errors)
+
+    if args.format == "json":
+        json.dump(result.to_dict(), sys.stdout, indent=2)
+        print()
+    else:
+        shown = result.problems if not args.show_suppressed \
+            else result.findings
+        for f in shown:
+            tag = ""
+            if f.suppressed:
+                tag = f"  (suppressed: {f.suppress_reason})"
+            elif f.baselined:
+                tag = "  (baselined)"
+            print(f.render() + tag)
+        for e in result.stale:
+            print(f"stale baseline entry: {e.render()} — the finding "
+                  f"is gone, delete the entry")
+        for msg in result.baseline_errors:
+            print(msg)
+        summary = result.summary()
+        if summary or result.stale or result.baseline_errors:
+            parts = [f"{rule}={n}" for rule, n in summary.items()]
+            if result.stale:
+                parts.append(f"stale-baseline={len(result.stale)}")
+            if result.baseline_errors:
+                parts.append(
+                    f"baseline-format={len(result.baseline_errors)}")
+            total = len(result.problems)
+            print(f"weedlint: {total} finding(s): {' '.join(parts)}")
+        else:
+            print("weedlint: clean")
+    if args.report_only:
+        return 0
+    return 0 if result.ok else 1

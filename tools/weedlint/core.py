@@ -1,0 +1,211 @@
+"""weedlint core: the shared single-walk visitor driver.
+
+Every rule is a class with a stable ``id`` (the thing suppression
+comments and the baseline key on), a set of AST node types it wants to
+see, and a ``visit(ctx, node)`` callback. The driver parses each file
+exactly once, builds the shared per-file context (parent links,
+enclosing-function map, finally-block membership), then dispatches
+each node of the walk to every interested rule — so adding a pass
+costs one class, not another O(tree) traversal.
+
+Findings flow through ``ctx.report(...)``; suppression comments
+(tools/weedlint/suppress.py) and the checked-in baseline
+(tools/weedlint/baseline.py) are applied after the walk, so a rule
+never needs to know either mechanism exists.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+from . import suppress
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+@dataclass
+class Finding:
+    """One problem at one site. ``rule`` is the stable id used by
+    ``# weedlint: ignore[rule]`` comments and baseline entries."""
+
+    path: str                   # path as given on the command line
+    rel: str                    # repo-relative (baseline key), '/'-sep
+    line: int
+    rule: str
+    message: str
+    code: str = ""              # stripped source line (baseline key)
+    suppressed: bool = False
+    suppress_reason: str = ""
+    baselined: bool = False
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"path": self.rel, "line": self.line, "rule": self.rule,
+                "message": self.message, "code": self.code,
+                "suppressed": self.suppressed,
+                "baselined": self.baselined}
+
+
+class Rule:
+    """Base class for one pass. Subclasses set ``id`` (kebab-case,
+    stable forever — suppressions and baselines reference it),
+    ``title``/``rationale``/``example``/``fix`` (the STATIC_ANALYSIS.md
+    catalog is generated from these), and ``node_types``; they get
+    ``visit`` calls for matching nodes plus optional ``begin``/
+    ``finish`` hooks around the walk."""
+
+    id: str = ""
+    title: str = ""
+    rationale: str = ""
+    example: str = ""
+    fix: str = ""
+    node_types: tuple = ()
+
+    def begin(self, ctx: "FileContext") -> None:  # pragma: no cover
+        pass
+
+    def visit(self, ctx: "FileContext", node: ast.AST) -> None:
+        raise NotImplementedError
+
+    def finish(self, ctx: "FileContext") -> None:  # pragma: no cover
+        pass
+
+
+class FileContext:
+    """Shared per-file analysis state built once per parse."""
+
+    def __init__(self, path: str, src: str, tree: ast.AST):
+        self.path = path
+        self.rel = relpath(path)
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = tree
+        self.findings: list[Finding] = []
+        self._parent: dict[int, ast.AST] = {}
+        self._func: dict[int, ast.AST | None] = {}
+        self._finally: set[int] = set()
+        self._index(tree)
+
+    def _index(self, tree: ast.AST) -> None:
+        stack: list[tuple[ast.AST, ast.AST | None]] = [(tree, None)]
+        while stack:
+            node, func = stack.pop()
+            self._func[id(node)] = func
+            child_func = node if isinstance(node, _FUNC_NODES) else func
+            for child in ast.iter_child_nodes(node):
+                self._parent[id(child)] = node
+                stack.append((child, child_func))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Try) and node.finalbody:
+                for stmt in node.finalbody:
+                    for sub in ast.walk(stmt):
+                        self._finally.add(id(sub))
+
+    # -- ancestry helpers ------------------------------------------------
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parent.get(id(node))
+
+    def parents(self, node: ast.AST):
+        cur = self._parent.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = self._parent.get(id(cur))
+
+    def enclosing_function(self, node: ast.AST) -> ast.AST | None:
+        """Nearest function-like ancestor (def / async def / lambda),
+        not counting `node` itself."""
+        return self._func.get(id(node))
+
+    def in_async_def(self, node: ast.AST) -> bool:
+        """True when the *nearest* enclosing function is ``async def``
+        — code inside a nested sync def/lambda (e.g. an executor thunk)
+        runs off the loop and is exempt by construction."""
+        return isinstance(self.enclosing_function(node),
+                          ast.AsyncFunctionDef)
+
+    def in_finally(self, node: ast.AST) -> bool:
+        return id(node) in self._finally
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    # -- reporting -------------------------------------------------------
+    def report(self, rule: Rule | str, node: ast.AST | int,
+               message: str) -> None:
+        rule_id = rule if isinstance(rule, str) else rule.id
+        line = node if isinstance(node, int) else node.lineno
+        self.findings.append(Finding(
+            path=self.path, rel=self.rel, line=line, rule=rule_id,
+            message=message, code=self.source_line(line)))
+
+
+def relpath(path: str) -> str:
+    """Repo-relative '/'-separated path when under the repo (the
+    stable baseline key), the input otherwise (fixture tmp files)."""
+    ap = os.path.abspath(path)
+    if ap == REPO or ap.startswith(REPO + os.sep):
+        return os.path.relpath(ap, REPO).replace(os.sep, "/")
+    return path.replace(os.sep, "/")
+
+
+def run_file(path: str, rules: list[Rule], *,
+             src: str | None = None,
+             check_unused: bool = True) -> list[Finding]:
+    """Lint one file with `rules`: parse once, one walk, dispatch by
+    node type, then apply suppression comments. Returns every finding
+    (suppressed ones included, flagged) so callers can choose between
+    enforcement and report-only."""
+    if src is None:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(path=path, rel=relpath(path), line=e.lineno or 1,
+                        rule="syntax-error",
+                        message=f"syntax error: {e.msg}")]
+    ctx = FileContext(path, src, tree)
+    dispatch: dict[type, list[Rule]] = {}
+    for r in rules:
+        r.begin(ctx)
+        for t in r.node_types:
+            dispatch.setdefault(t, []).append(r)
+    for node in ast.walk(tree):
+        for r in dispatch.get(type(node), ()):
+            r.visit(ctx, node)
+    for r in rules:
+        r.finish(ctx)
+    suppress.apply(ctx, check_unused=check_unused)
+    ctx.findings.sort(key=lambda f: (f.line, f.rule))
+    return ctx.findings
+
+
+def iter_py_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__"
+                             and not d.startswith("."))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def run_paths(paths: list[str], rules: list[Rule], *,
+              check_unused: bool = True) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in iter_py_files(paths):
+        findings += run_file(p, rules, check_unused=check_unused)
+    return findings

@@ -1,0 +1,57 @@
+"""Rule registry. Adding a pass = write the class, list it here,
+document it in STATIC_ANALYSIS.md (the catalog test cross-checks)."""
+
+from __future__ import annotations
+
+from .asynchrony import (AwaitInLockRule, BlockingIoRule,
+                         LockAcquireRule, OrphanTaskRule)
+from .cache import CacheInvalidateRule, FailpointSiteRule
+from .exceptions import SilentExceptRule
+from .executor import ExecutorCtxRule
+from .metrics import MetricHelpRule, MetricNameRule, SpanFinishRule
+from .resources import ResourceWithRule
+
+ALL_RULE_CLASSES = (
+    SilentExceptRule,
+    MetricNameRule,
+    MetricHelpRule,
+    SpanFinishRule,
+    BlockingIoRule,
+    OrphanTaskRule,
+    AwaitInLockRule,
+    LockAcquireRule,
+    ResourceWithRule,
+    CacheInvalidateRule,
+    FailpointSiteRule,
+    ExecutorCtxRule,
+)
+
+# findings the framework itself emits (no Rule class walks for these)
+META_RULE_IDS = ("suppress-format", "unused-suppression",
+                 "syntax-error")
+
+ALL_RULE_IDS = tuple(c.id for c in ALL_RULE_CLASSES)
+
+# the three passes the original tools/lint_robustness.py shipped —
+# its shim keeps exactly this behavior
+LEGACY_RULE_IDS = ("silent-except", "metric-name", "metric-help",
+                   "span-finish")
+
+
+def make_rules(select=None, ignore=None):
+    """Instantiate the ruleset. `select`/`ignore` are iterables of
+    rule ids; unknown ids raise (a typoed --select silently checking
+    nothing would 'pass' while testing nothing)."""
+    known = set(ALL_RULE_IDS)
+    for group in (select, ignore):
+        unknown = set(group or ()) - known
+        if unknown:
+            raise ValueError(
+                f"unknown rule id(s): {sorted(unknown)} "
+                f"(known: {sorted(known)})")
+    classes = ALL_RULE_CLASSES
+    if select:
+        classes = [c for c in classes if c.id in set(select)]
+    if ignore:
+        classes = [c for c in classes if c.id not in set(ignore)]
+    return [c() for c in classes]

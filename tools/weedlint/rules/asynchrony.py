@@ -1,0 +1,267 @@
+"""Rules for asyncio correctness: blocking calls on the event loop,
+fire-and-forget tasks, and lock/await interleavings.
+
+These are the bug classes PRs 1-4 actually shipped and hand-fixed:
+a blocking pread stalling every in-flight request, a dropped
+create_task whose exception wedged a connection forever, an await
+under a threading.Lock deadlocking the loop against its own executor
+threads.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, Rule
+
+# Module-attribute calls that block the calling thread. Deliberately
+# conservative: every entry here stalls the loop for a disk/DNS/sleep
+# latency, not a few ns.
+_BLOCKING_ATTRS: dict[str, set[str]] = {
+    "time": {"sleep"},
+    "os": {"open", "read", "write", "pread", "pwrite", "fsync",
+           "fdatasync", "sendfile", "ftruncate", "truncate",
+           "listdir", "scandir", "walk", "remove", "unlink",
+           "rename", "replace", "rmdir", "makedirs", "mkdir",
+           "stat", "fstat"},
+    "shutil": {"copy", "copyfile", "copyfileobj", "copytree",
+               "rmtree", "move"},
+    "mmap": {"mmap"},
+    "subprocess": {"run", "call", "check_call", "check_output",
+                   "Popen"},
+    "socket": {"create_connection", "getaddrinfo", "gethostbyname"},
+}
+_BLOCKING_NAMES = {"open", "input"}
+
+# lock-ish terminal names: `lock`, `_lock`, `vol_lock`, `mu`, `mutex`,
+# plus bare `rlock`/`wlock`. Deliberately NOT a bare `lock$` suffix —
+# that would flag `block`/`clock`/`datablock` context managers.
+LOCKISH_RE = re.compile(r"(?i)((^|_)(lock|mutex|mu)$)|(^[rw]?lock$)")
+
+
+def tail_name(node: ast.AST) -> str:
+    """`self._vol_lock` -> '_vol_lock', `lock` -> 'lock',
+    `x.lock()` -> 'lock' (the called attribute)."""
+    if isinstance(node, ast.Call):
+        return tail_name(node.func)
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _awaits_in(stmts):
+    """Await nodes in `stmts`, not descending into nested defs (their
+    awaits run on their own schedule, not under this block)."""
+    out = []
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Await):
+            out.append(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class BlockingIoRule(Rule):
+    id = "blocking-io"
+    title = "blocking call in an async def body"
+    rationale = ("a blocking disk/DNS/sleep call inside `async def` "
+                 "stalls every request sharing the event loop for the "
+                 "full latency — the whole-process stall class PR-3 "
+                 "hand-fixed by moving disk-tier mmap I/O off the "
+                 "loop. Thunks handed to run_in_executor are sync "
+                 "functions and exempt by construction.")
+    example = ("async def h(req):\n"
+               "    time.sleep(0.1)          # stalls the whole loop\n"
+               "    data = open(p).read()    # ditto")
+    fix = ("await asyncio.sleep(...), or route the I/O through "
+           "tracing.run_in_executor(fn, *args)")
+    node_types = (ast.Call,)
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        if not ctx.in_async_def(node):
+            return
+        func = node.func
+        what = ""
+        if isinstance(func, ast.Name) and func.id in _BLOCKING_NAMES:
+            what = func.id
+        elif (isinstance(func, ast.Attribute)
+              and isinstance(func.value, ast.Name)
+              and func.attr in _BLOCKING_ATTRS.get(func.value.id, ())):
+            what = f"{func.value.id}.{func.attr}"
+        if not what:
+            return
+        ctx.report(self, node,
+                   f"blocking call {what}() on the event loop — "
+                   f"stalls every in-flight request; route through "
+                   f"tracing.run_in_executor (or asyncio.sleep for "
+                   f"sleeps)")
+
+
+class OrphanTaskRule(Rule):
+    id = "orphan-task"
+    title = "create_task/ensure_future result dropped"
+    rationale = ("a task whose handle is dropped can be GC-cancelled "
+                 "mid-flight, and its exception is silently parked "
+                 "until interpreter exit — the PR-1 class where a "
+                 "fire-and-forget handler task wedged its connection "
+                 "forever. Retain the handle and give it a "
+                 "done-callback (or await it).")
+    example = "asyncio.create_task(self._heartbeat_loop())"
+    fix = ("keep the handle (self._tasks.append(...)) and attach "
+           "add_done_callback, or await it")
+    node_types = (ast.Call,)
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        func = node.func
+        name = ""
+        if isinstance(func, ast.Attribute) and func.attr in (
+                "create_task", "ensure_future"):
+            name = func.attr
+        elif isinstance(func, ast.Name) and func.id in (
+                "create_task", "ensure_future"):
+            name = func.id
+        if not name:
+            return
+        parent = ctx.parent(node)
+        dropped = isinstance(parent, ast.Expr)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name) \
+                and parent.targets[0].id == "_":
+            dropped = True
+        if dropped:
+            ctx.report(self, node,
+                       f"{name}() result dropped — the task can be "
+                       f"GC-collected mid-flight and its exception is "
+                       f"never observed; retain the handle and attach "
+                       f"a done-callback")
+
+
+class AwaitInLockRule(Rule):
+    id = "await-in-lock"
+    title = "await while holding a synchronous lock"
+    rationale = ("`with threading.Lock(): await ...` parks the "
+                 "coroutine while the OS lock stays held; any executor "
+                 "thread (or another coroutine resumed on this loop) "
+                 "that wants the lock deadlocks the process.")
+    example = ("with self._lock:\n"
+               "    await client.upload(...)")
+    fix = ("shrink the critical section so no await happens under the "
+           "lock, or switch to asyncio.Lock + async with")
+    node_types = (ast.With,)
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.With)
+        lockish = [item for item in node.items
+                   if LOCKISH_RE.search(tail_name(item.context_expr))]
+        if not lockish:
+            return
+        awaits = _awaits_in(node.body)
+        if not awaits:
+            return
+        name = tail_name(lockish[0].context_expr)
+        first = min(a.lineno for a in awaits)
+        ctx.report(self, node,
+                   f"await at line {first} while holding sync lock "
+                   f"{name!r} — a coroutine parked under an OS lock "
+                   f"deadlocks executor threads; shrink the critical "
+                   f"section or use asyncio.Lock with `async with`")
+
+
+class LockAcquireRule(Rule):
+    id = "lock-acquire"
+    title = "asyncio lock acquired without async-with discipline"
+    rationale = ("`await lock.acquire()` not immediately followed by "
+                 "try/finally release leaks the lock on any exception "
+                 "between acquire and release — every later waiter "
+                 "hangs forever. And a *sync* `with` on an "
+                 "asyncio.Lock raises at runtime only when that path "
+                 "finally executes.")
+    example = ("await self._lock.acquire()\n"
+               "do_work()   # an exception here orphans the lock")
+    fix = "use `async with lock:`"
+    node_types = (ast.Await, ast.With)
+
+    def begin(self, ctx: FileContext) -> None:
+        # names bound to asyncio.Lock()/Semaphore()/Condition() in this
+        # file (x = asyncio.Lock() and self.x = asyncio.Lock())
+        self._async_locks: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            f = node.value.func
+            if not (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "asyncio"
+                    and f.attr in ("Lock", "Semaphore",
+                                   "BoundedSemaphore", "Condition")):
+                continue
+            for t in node.targets:
+                n = tail_name(t)
+                if n:
+                    self._async_locks.add(n)
+
+    @staticmethod
+    def _releases(stmts, holder: str) -> bool:
+        for stmt in stmts:
+            for fin in ast.walk(stmt):
+                if (isinstance(fin, ast.Call)
+                        and isinstance(fin.func, ast.Attribute)
+                        and fin.func.attr == "release"
+                        and tail_name(fin.func.value) == holder):
+                    return True
+        return False
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        if isinstance(node, ast.With):
+            for item in node.items:
+                n = tail_name(item.context_expr)
+                if n and n in self._async_locks:
+                    ctx.report(self, node,
+                               f"sync `with` on asyncio lock {n!r} — "
+                               f"asyncio locks only support `async "
+                               f"with` (this raises at runtime on the "
+                               f"first contended path)")
+            return
+        assert isinstance(node, ast.Await)
+        call = node.value
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "acquire"):
+            return
+        holder = tail_name(call.func.value)
+        stmt = ctx.parent(node)
+        if not isinstance(stmt, ast.Expr):
+            # e.g. `ok = await lock.acquire()` — still manual, flag it
+            stmt = stmt if isinstance(stmt, ast.stmt) else None
+        if stmt is None:
+            return
+        parent = ctx.parent(stmt)
+        body = getattr(parent, "body", None)
+        protected = False
+        if isinstance(body, list) and stmt in body:
+            i = body.index(stmt)
+            # canonical: acquire, then try/finally release
+            if i + 1 < len(body) and isinstance(body[i + 1], ast.Try):
+                protected = self._releases(body[i + 1].finalbody,
+                                           holder)
+        if not protected and isinstance(parent, ast.Try) \
+                and stmt in parent.body:
+            # tolerated variant: acquire as the first statement of a
+            # try whose finally releases
+            protected = self._releases(parent.finalbody, holder)
+        if not protected:
+            ctx.report(self, node,
+                       f"manual `await {holder}.acquire()` without an "
+                       f"immediate try/finally {holder}.release() — an "
+                       f"exception in between orphans the lock; use "
+                       f"`async with {holder}:`")

@@ -1,0 +1,156 @@
+"""Rules for this repo's two hand-rolled disciplines:
+
+* cache-invalidate — PR-3 put read caches under the volume store and
+  the client; every mutating entry point must visibly invalidate (or
+  carry a suppression explaining why it cannot race a cached read).
+* failpoint-site — PR-2's chaos harness only exercises faults at
+  planted sites; a new outbound network / raw-disk call in the data
+  plane that plants no failpoint is invisible to the soak.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, Rule
+from .asynchrony import tail_name
+
+# class name -> (method regex that mutates, what must be mentioned)
+MUTATOR_SPECS: dict[str, re.Pattern] = {
+    "Store": re.compile(
+        r"^_?(write|delete|vacuum|commit|mount|unmount|batch_delete"
+        r"|truncate|apply_tail|receive_tail)"),
+    "WeedClient": re.compile(r"^(upload|delete)"),
+}
+# identifier substrings that count as touching the cache layer
+_EVIDENCE = ("cache", "invalid", "drop", "gen_fence", "bump_gen")
+
+_HTTP_VERBS = {"get", "post", "put", "delete", "head", "patch",
+               "request"}
+_SESSIONISH = re.compile(r"(?i)(sess|session|http|client)$")
+# repo-relative path fragments where the failpoint discipline applies
+# (the data plane the chaos soak drives)
+FAILPOINT_SCOPE = ("seaweedfs_tpu/server/", "seaweedfs_tpu/replication/",
+                   "seaweedfs_tpu/util/client.py",
+                   "seaweedfs_tpu/util/masterclient.py",
+                   "seaweedfs_tpu/storage/store.py")
+
+
+def _mentions_evidence(fn: ast.AST, spec: re.Pattern) -> bool:
+    for node in ast.walk(fn):
+        name = ""
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and any(s in name.lower() for s in _EVIDENCE):
+            return True
+        # delegation to a sibling mutator (self.upload(...) from
+        # upload_data) counts: the invalidation is checked there
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+                and node.func.attr != getattr(fn, "name", "")
+                and spec.match(node.func.attr)):
+            return True
+    return False
+
+
+class CacheInvalidateRule(Rule):
+    id = "cache-invalidate"
+    title = "mutating entry point with no visible cache invalidation"
+    rationale = ("PR-3's needle/chunk caches answer reads without "
+                 "touching disk; a write/delete/vacuum/commit path "
+                 "that forgets to invalidate serves stale bytes "
+                 "forever after. Mechanically: every mutating method "
+                 "on Store/WeedClient must reference the cache layer "
+                 "(invalidate/drop/generation bump) somewhere in its "
+                 "body.")
+    example = ("class Store:\n"
+               "    def write_needle(self, vid, n):\n"
+               "        return self._volume(vid).write(n)  # no "
+               "invalidation")
+    fix = ("invalidate/drop the affected cache entries (or bump the "
+           "generation fence) before acking the mutation; if the "
+           "method genuinely cannot race a cached read, suppress with "
+           "the reason")
+    node_types = (ast.ClassDef,)
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.ClassDef)
+        spec = MUTATOR_SPECS.get(node.name)
+        if spec is None:
+            return
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not spec.match(item.name):
+                continue
+            if _mentions_evidence(item, spec):
+                continue
+            ctx.report(self, item,
+                       f"{node.name}.{item.name} mutates state but "
+                       f"never references the cache layer "
+                       f"(invalidate/drop/generation) — a cached read "
+                       f"racing this returns stale bytes")
+
+
+class FailpointSiteRule(Rule):
+    id = "failpoint-site"
+    title = "data-plane I/O call site without failpoint coverage"
+    rationale = ("the chaos soak can only inject faults at planted "
+                 "failpoint sites; an outbound HTTP call or raw "
+                 "pread/pwrite added to the data plane without one is "
+                 "a path the soak can never break, i.e. never proves. "
+                 "Scope: server/, replication/, util/client.py, "
+                 "util/masterclient.py, storage/store.py.")
+    example = ("async def replicate(self, url, body):\n"
+               "    await self._session.post(url, data=body)  # no "
+               "failpoints.fail(...) in reach")
+    fix = ("plant `await failpoints.fail('<tier>.<op>')` (or "
+           "sync_fail/corrupt) in the function before the call, or "
+           "suppress with a pointer to the site that already covers "
+           "this path one level up")
+    node_types = (ast.Call,)
+
+    def _function_has_failpoint(self, ctx: FileContext,
+                                fn: ast.AST | None) -> bool:
+        scope = fn if fn is not None else ctx.tree
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "failpoints":
+                return True
+            if isinstance(node, ast.Name) and node.id == "failpoints":
+                return True
+        return False
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        if not any(frag in ctx.rel for frag in FAILPOINT_SCOPE):
+            return
+        f = node.func
+        site = ""
+        if isinstance(f, ast.Attribute) and f.attr in _HTTP_VERBS \
+                and _SESSIONISH.search(tail_name(f.value) or ""):
+            site = f"{tail_name(f.value)}.{f.attr}"
+        elif isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id == "os" and f.attr in ("pread",
+                                                      "pwrite"):
+            site = f"os.{f.attr}"
+        if not site:
+            return
+        fn = ctx.enclosing_function(node)
+        while isinstance(fn, ast.Lambda):
+            fn = ctx.enclosing_function(fn)
+        if self._function_has_failpoint(ctx, fn):
+            return
+        ctx.report(self, node,
+                   f"outbound {site}(...) in the data plane with no "
+                   f"failpoint in the enclosing function — the chaos "
+                   f"soak cannot exercise this path's failure "
+                   f"handling")

@@ -1,0 +1,50 @@
+"""Rule: silent broad exception handlers (the original pass 1)."""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Rule
+
+BROAD = {"Exception", "BaseException"}
+
+
+def is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True                          # bare except:
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in BROAD:
+            return True
+    return False
+
+
+def is_silent(handler: ast.ExceptHandler) -> bool:
+    return all(isinstance(s, (ast.Pass, ast.Continue))
+               for s in handler.body)
+
+
+class SilentExceptRule(Rule):
+    id = "silent-except"
+    title = "silent broad exception handler"
+    rationale = ("`except Exception: pass` turns real faults "
+                 "invisible — a wedged peer, a torn write and a typo "
+                 "all vanish identically. Narrow handlers may still "
+                 "swallow (idempotent deletes, probe loops); broad "
+                 "ones must log.")
+    example = "try: g()\nexcept Exception:\n    pass"
+    fix = ("narrow the exception type, or glog the fault before "
+           "swallowing it")
+    node_types = (ast.ExceptHandler,)
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.ExceptHandler)
+        if is_broad(node) and is_silent(node):
+            what = "bare except" if node.type is None \
+                else "except Exception"
+            ctx.report(self, node,
+                       f"silent {what}: pass — narrow the exception "
+                       f"type and/or glog the fault")

@@ -1,0 +1,69 @@
+"""Rule: contextvar/trace propagation into executor thunks.
+
+asyncio does NOT copy contextvars into run_in_executor threads, so a
+store/EC span started in a worker thread parents under nothing and
+the trace breaks exactly at the layer whose latency matters most —
+the PR-4 class fixed by util/tracing.run_in_executor. Every direct
+loop.run_in_executor call must either go through that helper or
+visibly copy the context itself.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Rule
+
+
+def _subtree_mentions(node: ast.AST, names: set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in names:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in names:
+            return True
+    return False
+
+
+class ExecutorCtxRule(Rule):
+    id = "executor-ctx"
+    title = "run_in_executor without context propagation"
+    rationale = ("contextvars (tracing parenthood, request ids) do "
+                 "not cross into executor threads on their own; a raw "
+                 "loop.run_in_executor severs the trace at the "
+                 "disk/EC layer. util/tracing.run_in_executor pays "
+                 "the context copy only while a trace is active.")
+    example = ("await loop.run_in_executor(None,\n"
+               "    lambda: store.read_needle(vid, nid))")
+    fix = ("await tracing.run_in_executor(fn, *args), or wrap the "
+           "thunk in contextvars.copy_context().run yourself")
+    node_types = (ast.Call,)
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        f = node.func
+        if not (isinstance(f, ast.Attribute)
+                and f.attr == "run_in_executor"):
+            return
+        # the blessed helper itself: tracing.run_in_executor(fn, ...)
+        if isinstance(f.value, ast.Name) and f.value.id == "tracing":
+            return
+        if ctx.rel.endswith("util/tracing.py"):
+            return                  # the helper's own implementation
+        # visible propagation: copy_context at the call site itself...
+        if _subtree_mentions(node, {"copy_context"}):
+            return
+        # ...or a contextvars.copy_context() call in the enclosing
+        # function whose result the thunk runs under. A bare name
+        # `ctx`/`run` is NOT evidence — an argument that happens to be
+        # called ctx must not disable the rule.
+        fn = ctx.enclosing_function(node)
+        if fn is not None and any(
+                isinstance(s, ast.Call) and _subtree_mentions(
+                    s.func, {"copy_context"})
+                for s in ast.walk(fn)):
+            return
+        ctx.report(self, node,
+                   "raw run_in_executor severs contextvars (trace "
+                   "parenthood) at the thread boundary — use "
+                   "tracing.run_in_executor(fn, *args) or copy the "
+                   "context explicitly")

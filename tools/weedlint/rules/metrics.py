@@ -1,0 +1,95 @@
+"""Rules: metrics + tracing-span hygiene (the original passes 2-3)."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import FileContext, Rule
+
+METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
+# SeaweedFS_ prefix then a lowercase-led snake-ish name; interior
+# camelCase segments are allowed (the reference's own idiom:
+# SeaweedFS_volumeServer_request_total)
+METRIC_NAME_RE = re.compile(r"^SeaweedFS_[a-z][A-Za-z0-9_]*$")
+SPAN_NAME_RE = re.compile(r"^(sp|rsp|span|.*_span|.*_sp)$")
+
+
+def _ctor_name(node: ast.Call) -> str:
+    func = node.func
+    return func.id if isinstance(func, ast.Name) else (
+        func.attr if isinstance(func, ast.Attribute) else "")
+
+
+class MetricNameRule(Rule):
+    id = "metric-name"
+    title = "metric name outside the SeaweedFS_ namespace"
+    rationale = ("every Counter/Gauge/Histogram shares one registry "
+                 "and one /metrics page; names must carry the "
+                 "SeaweedFS_ prefix with a lowercase-led tail so the "
+                 "whole-host merge and dashboards can rely on one "
+                 "namespace.")
+    example = 'Counter("my_requests_total", "requests")'
+    fix = 'rename to SeaweedFS_<subsystem>_<what>_total'
+    node_types = (ast.Call,)
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        if _ctor_name(node) not in METRIC_CTORS or len(node.args) < 1:
+            return
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value,
+                                                          str):
+            if not METRIC_NAME_RE.match(first.value):
+                ctx.report(self, node,
+                           f"metric name {first.value!r} must match "
+                           f"SeaweedFS_[a-z]... (one registry "
+                           f"namespace, lowercase-led)")
+
+
+class MetricHelpRule(Rule):
+    id = "metric-help"
+    title = "metric registered without help text"
+    rationale = ("the help string is the only documentation a metric "
+                 "gets on /metrics; an empty one ships an unlabeled "
+                 "number to every dashboard.")
+    example = 'Histogram("SeaweedFS_request_seconds", "")'
+    fix = "write one line of help text"
+    node_types = (ast.Call,)
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        name = _ctor_name(node)
+        if name not in METRIC_CTORS or len(node.args) < 1:
+            return
+        help_arg = node.args[1] if len(node.args) > 1 else None
+        if help_arg is None or (isinstance(help_arg, ast.Constant)
+                                and not str(help_arg.value or "").strip()):
+            ctx.report(self, node,
+                       f"metric {name} needs non-empty help text")
+
+
+class SpanFinishRule(Rule):
+    id = "span-finish"
+    title = "span.finish() outside a finally block"
+    rationale = ("an exception on any path between start() and "
+                 "finish() leaks an unfinished span out of the "
+                 "in-flight table; `with tracing.start(...)` or a "
+                 "finally makes every path finish.")
+    example = 'sp = tracing.start("x", "y")\nsp.finish("ok")'
+    fix = "use `with tracing.start(...)` or move finish() into finally"
+    node_types = (ast.Call,)
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and func.attr == "finish"
+                and isinstance(func.value, ast.Name)
+                and SPAN_NAME_RE.match(func.value.id)):
+            return
+        if ctx.in_finally(node):
+            return
+        ctx.report(self, node,
+                   f"span {func.value.id}.finish() outside a finally "
+                   f"— an exception path would leak the span (use "
+                   f"`with` or move the finish into finally)")

@@ -1,0 +1,130 @@
+"""Rule: resources constructed outside with / try-finally close.
+
+An aiohttp.ClientSession, socket or file handle bound to a local and
+closed only on the happy path leaks on the first exception — fd
+exhaustion under fault injection is exactly how the chaos soak finds
+these. Ownership transfers (returned, stored on self, passed to
+another call, yielded) are exempt: the receiver owns the close.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Rule
+from .asynchrony import tail_name
+
+_CTOR_ATTRS: dict[str, set[str]] = {
+    "aiohttp": {"ClientSession", "TCPConnector", "UnixConnector"},
+    "socket": {"socket"},
+    "os": {"fdopen"},
+    "io": {"open"},
+    "mmap": {"mmap"},
+    "tempfile": {"NamedTemporaryFile", "TemporaryFile",
+                 "TemporaryDirectory"},
+}
+_CTOR_NAMES = {"open", "ClientSession"}
+_CLOSERS = {"close", "aclose", "shutdown", "terminate", "stop",
+            "release_conn", "unlink", "cleanup"}
+
+
+def _ctor_label(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id in _CTOR_NAMES:
+        return f.id
+    if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and f.attr in _CTOR_ATTRS.get(f.value.id, ())):
+        return f"{f.value.id}.{f.attr}"
+    return ""
+
+
+class ResourceWithRule(Rule):
+    id = "resource-with"
+    title = "resource constructed outside with/try-finally"
+    rationale = ("a session/socket/file closed only on the happy path "
+                 "leaks its fd (and for ClientSession, its connector "
+                 "pool) on the first exception; under fault injection "
+                 "that compounds into fd exhaustion. `with` / close in "
+                 "a finally makes every path release.")
+    example = ("sess = aiohttp.ClientSession()\n"
+               "await sess.get(url)    # an exception leaks the pool\n"
+               "await sess.close()")
+    fix = ("`async with aiohttp.ClientSession() as sess:` (or close "
+           "in a finally); for long-lived members, store on self and "
+           "close in the owner's close()")
+    node_types = (ast.Call,)
+
+    def visit(self, ctx: FileContext, node: ast.AST) -> None:
+        assert isinstance(node, ast.Call)
+        label = _ctor_label(node)
+        if not label:
+            return
+        parent = ctx.parent(node)
+        # unwrap an `await aiohttp.ClientSession()`-style wrapper
+        if isinstance(parent, ast.Await):
+            parent = ctx.parent(parent)
+        if isinstance(parent, ast.withitem):
+            return                              # with CTOR() as x: ...
+        if isinstance(parent, ast.Attribute):
+            ctx.report(self, node,
+                       f"{label}(...).{parent.attr} chains off an "
+                       f"unbound resource — nothing can ever close "
+                       f"it; bind it in a `with`")
+            return
+        if isinstance(parent, ast.Expr):
+            ctx.report(self, node,
+                       f"{label}() result discarded — the resource "
+                       f"can never be closed")
+            return
+        if isinstance(parent, ast.Assign) \
+                and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name):
+            self._check_scope(ctx, node, label,
+                              parent.targets[0].id, parent)
+        # every other shape (return CTOR(), f(CTOR()), self.x = CTOR(),
+        # containers, ann-assign to attributes) transfers ownership —
+        # the receiver is responsible, often a different file.
+
+    def _check_scope(self, ctx: FileContext, node: ast.Call,
+                     label: str, name: str, assign: ast.Assign) -> None:
+        scope = ctx.enclosing_function(node) or ctx.tree
+        body = scope.body if not isinstance(scope, ast.Lambda) else []
+        closed_in_finally = False
+        for sub in ast.walk(ast.Module(body=list(body),
+                                       type_ignores=[])):
+            # ownership escapes: someone else closes it
+            if isinstance(sub, ast.withitem) \
+                    and tail_name(sub.context_expr) == name:
+                return
+            if isinstance(sub, ast.Return) and sub.value is not None \
+                    and name in _names_in(sub.value):
+                return
+            if isinstance(sub, (ast.Yield, ast.YieldFrom)) \
+                    and sub.value is not None \
+                    and name in _names_in(sub.value):
+                return
+            if isinstance(sub, ast.Call) and sub is not node:
+                f = sub.func
+                if isinstance(f, ast.Attribute) \
+                        and tail_name(f.value) == name \
+                        and f.attr in _CLOSERS:
+                    if ctx.in_finally(sub):
+                        closed_in_finally = True
+                    continue
+                for a in list(sub.args) + [k.value for k in
+                                           sub.keywords]:
+                    if name in _names_in(a):
+                        return              # handed to another owner
+            if isinstance(sub, ast.Assign) and sub is not assign \
+                    and sub.value is not None \
+                    and name in _names_in(sub.value):
+                return                      # aliased / stored away
+        if not closed_in_finally:
+            ctx.report(self, node,
+                       f"{label}() bound to {name!r} with no `with` "
+                       f"and no close() in a finally — an exception "
+                       f"path leaks the resource")
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}

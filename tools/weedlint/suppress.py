@@ -1,0 +1,118 @@
+"""Per-line suppression comments.
+
+Grammar (same line as the finding, or alone on the line above):
+
+    # weedlint: ignore[rule-id] reason text
+    # weedlint: ignore[rule-a,rule-b] one reason for both
+
+The reason is mandatory: a suppression is a reviewed claim that the
+finding is a false positive (or deliberately accepted), and the claim
+must be written down. A reasonless or malformed suppression is itself
+a finding (``suppress-format``), and — when the full ruleset runs — a
+suppression that matches no finding is flagged too
+(``unused-suppression``) so dead suppressions can't accrete the way
+stale ``noqa``s do.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+
+SUPPRESS_RE = re.compile(
+    r"#\s*weedlint:\s*ignore\[([^\]]*)\]\s*(.*)$")
+# anything that *tries* to be a weedlint comment but doesn't parse
+ATTEMPT_RE = re.compile(r"#\s*weedlint\b")
+RULE_ID_RE = re.compile(r"^[a-z][a-z0-9-]*$")
+
+
+class Suppression:
+    __slots__ = ("line", "rules", "reason", "used")
+
+    def __init__(self, line: int, rules: set[str], reason: str):
+        self.line = line            # line the suppression covers
+        self.rules = rules
+        self.reason = reason
+        self.used = False
+
+
+def _comments(src: str) -> list[tuple[int, str, bool]]:
+    """(line, comment_text, own_line) for every real COMMENT token —
+    tokenize, not a regex over lines, so the suppression grammar
+    quoted in a docstring (like this module's) is never parsed."""
+    out = []
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in toks:
+            if tok.type == tokenize.COMMENT:
+                own_line = tok.line[:tok.start[1]].strip() == ""
+                out.append((tok.start[0], tok.string, own_line))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass                        # unparseable tail: no suppressions
+    return out
+
+
+def parse(ctx) -> list[Suppression]:
+    """Scan comment tokens for suppressions. A comment-only line
+    covers the next line; a trailing comment covers its own line.
+    Malformed attempts are reported via ctx (suppress-format)."""
+    sups: list[Suppression] = []
+    for i, raw, own_line in _comments(ctx.src):
+        if "weedlint" not in raw:
+            continue
+        m = SUPPRESS_RE.search(raw)
+        if not m:
+            if ATTEMPT_RE.search(raw):
+                ctx.report("suppress-format", i,
+                           "malformed weedlint comment — want "
+                           "`# weedlint: ignore[rule-id] reason`")
+            continue
+        ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+        reason = m.group(2).strip()
+        bad = [r for r in ids if not RULE_ID_RE.match(r)]
+        if not ids or bad:
+            ctx.report("suppress-format", i,
+                       f"bad rule id(s) {sorted(bad) or '[]'} in "
+                       f"suppression — ids are kebab-case, see "
+                       f"--list-rules")
+            continue
+        if not reason:
+            ctx.report("suppress-format", i,
+                       f"suppression for {sorted(ids)} has no reason — "
+                       f"every ignore must say why")
+            continue
+        covered = i + 1 if own_line else i
+        sups.append(Suppression(covered, ids, reason))
+    return sups
+
+
+def apply(ctx, *, check_unused: bool = True) -> None:
+    """Mark findings matched by a suppression; flag unused ones.
+
+    ``check_unused`` is off when only a rule subset runs (--select):
+    a suppression for an unselected rule would look unused even though
+    the full run needs it."""
+    sups = parse(ctx)
+    if not sups:
+        return
+    by_line: dict[int, list[Suppression]] = {}
+    for s in sups:
+        by_line.setdefault(s.line, []).append(s)
+    for f in ctx.findings:
+        if f.rule in ("suppress-format", "unused-suppression"):
+            continue                # the meta-rules are unsuppressable
+        for s in by_line.get(f.line, ()):
+            if f.rule in s.rules:
+                f.suppressed = True
+                f.suppress_reason = s.reason
+                s.used = True
+                break
+    if check_unused:
+        for s in sups:
+            if not s.used:
+                ctx.report("unused-suppression", s.line,
+                           f"suppression for {sorted(s.rules)} matches "
+                           f"no finding — delete it (the bug it excused "
+                           f"is gone)")
+    ctx.findings.sort(key=lambda f: (f.line, f.rule))
