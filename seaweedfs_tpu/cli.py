@@ -151,6 +151,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "3/4 hot-needle + 1/4 EC reconstruction "
                         "(strictly invalidated on write/delete/vacuum); "
                         "0 disables all volume-side read caching")
+    v.add_argument("-batch.max", dest="batch_max", type=int, default=256,
+                   help="most fids one /batch multi-needle GET may "
+                        "carry (the unified wire's pipelined read)")
+    v.add_argument("-groupcommit.ms", dest="groupcommit_ms", type=float,
+                   default=0.0,
+                   help="extra window the group-commit leader lingers "
+                        "to deepen write batches; 0 = natural batching "
+                        "(coalesce exactly when writers contend, zero "
+                        "added latency for a lone writer)")
+    v.add_argument("-fsync", action="store_true",
+                   help="fsync every group-committed append before "
+                        "acking writers (default keeps the historical "
+                        "flush-only durability point)")
 
     f = sub.add_parser("filer", help="start a filer server")
     _add_common(f)
@@ -304,15 +317,23 @@ def build_parser() -> argparse.ArgumentParser:
                     default="false", choices=("true", "false"),
                     help="read fids in list order instead of shuffled")
     bm.add_argument("-readMode", default="",
-                    choices=("", "shuffle", "sequential", "zipf"),
+                    choices=("", "shuffle", "sequential", "zipf",
+                             "batch"),
                     help="read-order distribution; zipf = repeated "
                          "hot-key reads (the cache-effectiveness "
-                         "workload; overrides -readSequentially)")
+                         "workload; overrides -readSequentially); "
+                         "batch = shuffled order over multi-needle "
+                         "/batch GETs")
     bm.add_argument("-readN", type=int, default=0,
                     help="total read requests (0 = one per fid); with "
                          "-readMode zipf the same hot fids repeat")
     bm.add_argument("-zipfS", type=float, default=1.1,
                     help="zipf exponent for -readMode zipf")
+    bm.add_argument("-batchSize", type=int, default=0,
+                    help="reads per multi-needle /batch request; >0 "
+                         "batches ANY -readMode's order (-readMode "
+                         "batch implies 32); reports req/s and "
+                         "needles/s")
 
     bk = sub.add_parser("backup", help="incrementally back up one volume "
                                        "from a volume server to a local dir")
@@ -622,13 +643,16 @@ async def _run_volume(args) -> None:
                   index_type=args.index,
                   partition=(None if worker_ctx is None else
                              (worker_ctx.index, worker_ctx.total)),
-                  needle_cache_bytes=args.cache_mem * 1024 * 1024)
+                  needle_cache_bytes=args.cache_mem * 1024 * 1024,
+                  group_commit_window=args.groupcommit_ms / 1000.0,
+                  fsync=args.fsync)
     vs = VolumeServer(store, args.master, ip=args.ip, port=args.port,
                       data_center=args.dataCenter, rack=args.rack,
                       pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
                       white_list=parse_white_list(args.whiteList),
                       public_url=args.publicUrl,
-                      worker_ctx=worker_ctx)
+                      worker_ctx=worker_ctx,
+                      batch_max=args.batch_max)
     await vs.start()
     if worker_ctx is not None:
         print(f"volume worker {worker_ctx.index}/{worker_ctx.total}: "
@@ -1103,6 +1127,11 @@ async def _run_benchmark(args) -> None:
     vol_locs: dict[str, str] = {}       # vid -> host:port (lookup cache)
     read_bytes = 0
     wi = ri = 0                          # shared cursors (single loop)
+    # -batchSize / -readMode batch: reads ride multi-needle /batch GETs
+    batch_size = args.batchSize or (32 if args.readMode == "batch"
+                                    else 0)
+    read_reqs = 0                        # wire requests (batch != needle)
+    needles_read = 0
 
     async def lookup(mconn: _RawConn, vid: str) -> str:
         url = vol_locs.get(vid)
@@ -1116,7 +1145,7 @@ async def _run_benchmark(args) -> None:
         return url
 
     async def worker(phase: str, order: list[str]) -> None:
-        nonlocal deletes, read_bytes, wi, ri
+        nonlocal deletes, read_bytes, wi, ri, read_reqs, needles_read
         mconn = await _RawConn.open(master)
         vconns: dict[str, _RawConn] = {}
 
@@ -1160,6 +1189,36 @@ async def _run_benchmark(args) -> None:
                         deletes += 1
                     else:
                         fids.append(fid)
+                elif batch_size:
+                    if ri >= len(order):
+                        return
+                    group = order[ri:ri + batch_size]
+                    ri += len(group)
+                    # one /batch request per holding server (single
+                    # server in this harness, but correct regardless)
+                    by_server: dict[str, list[str]] = {}
+                    for fid in group:
+                        by_server.setdefault(
+                            await lookup(mconn, fid.split(",")[0]),
+                            []).append(fid)
+                    from .util.batchframe import parse_all
+                    for server, fids_here in by_server.items():
+                        vc = await vconn(server)
+                        t0 = time.perf_counter()
+                        st, data = await vc.request(
+                            "GET", "/batch?fids=" + ",".join(fids_here))
+                        read_lat.append(time.perf_counter() - t0)
+                        if st != 200:
+                            raise RuntimeError(f"batch read: {st} "
+                                               f"{data[:200]!r}")
+                        read_reqs += 1
+                        for meta, body in parse_all(data):
+                            if meta.get("status") != 200:
+                                raise RuntimeError(
+                                    f"batch row {meta.get('fid')}: "
+                                    f"{meta.get('status')}")
+                            needles_read += 1
+                            read_bytes += len(body)
                 else:
                     if ri >= len(order):
                         return
@@ -1173,6 +1232,8 @@ async def _run_benchmark(args) -> None:
                         raise RuntimeError(f"read {fid}: {st}")
                     read_lat.append(time.perf_counter() - t0)
                     read_bytes += len(data)
+                    read_reqs += 1
+                    needles_read += 1
         finally:
             mconn.close()
             for c in vconns.values():
@@ -1230,8 +1291,14 @@ async def _run_benchmark(args) -> None:
     if do_read and fids:
         # measured bytes, not -size: a -write=false run may read fids
         # written with a different size
-        print(f"read:  {n_reads / rdt:.1f} req/s, "
+        print(f"read:  {read_reqs / rdt:.1f} req/s, "
               f"{read_bytes / rdt / 1024:.1f} KB/s")
+        if batch_size:
+            # the amortization headline: needles served per second vs
+            # wire round trips spent serving them
+            print(f"  needles/s: {needles_read / rdt:.1f} "
+                  f"(batch={batch_size}, {needles_read} needles over "
+                  f"{read_reqs} requests)")
         print(f"  latency ms p50/p95/p99/max: {pct(read_lat, 50):.1f}/"
               f"{pct(read_lat, 95):.1f}/{pct(read_lat, 99):.1f}/"
               f"{max(read_lat) * 1e3:.1f}")

@@ -46,6 +46,7 @@ class EcVolume:
                  small_block: int = SMALL_BLOCK_SIZE,
                  encoder=None,
                  fetch_remote: Callable[[int, int, int], bytes | None] | None = None,
+                 fetch_remote_batch=None,
                  recover_cache=None):
         self.dir = dirname
         self.collection = collection
@@ -64,6 +65,10 @@ class EcVolume:
         self._default_encoder = None
         self._small_encoder = None
         self.fetch_remote = fetch_remote
+        # batched form: fn([(sid, off, size), ...]) -> dict[sid, bytes]
+        # | None — one request per remote HOLDER instead of one per
+        # shard interval (the recover gather's network fan-out)
+        self.fetch_remote_batch = fetch_remote_batch
         base = collection + "_" + str(vid) if collection else str(vid)
         self.base_name = os.path.join(dirname, base)
         self._ecx = SortedFileNeedleMap(self.base_name + ".ecx",
@@ -168,21 +173,54 @@ class EcVolume:
         # attributable per request, not only in aggregate
         with tracing.start("ec", "recover", vid=self.vid,
                            shard=want_sid) as sp:
+            # local shards first (free), then ONE batched remote gather
+            # for however many more the decode needs — the k-fetch
+            # network fan-out collapses to one request per holder
+            local: dict[int, bytes] = {}
+            want_remote: list[int] = []
+            for sid in range(gf.TOTAL_SHARDS):
+                if sid == want_sid:
+                    continue
+                f = self.shards.get(sid)
+                if f is not None and len(local) < gf.DATA_SHARDS:
+                    raw = os.pread(f.fileno(), size, offset)
+                    local[sid] = raw + b"\x00" * (size - len(raw))
+                elif f is None:
+                    want_remote.append(sid)
+            remote: dict[int, bytes] = {}
+            missing = gf.DATA_SHARDS - len(local)
+            if missing > 0 and want_remote:
+                batch = None
+                if self.fetch_remote_batch is not None:
+                    # only as many intervals as the decode still needs:
+                    # over-asking would move (and pread) extra repair
+                    # bytes on every holder; the per-shard fallback
+                    # below covers holders that failed to serve
+                    batch = self.fetch_remote_batch(
+                        [(sid, offset, size)
+                         for sid in want_remote[:missing]])
+                if batch:
+                    for sid in want_remote:
+                        data = batch.get(sid)
+                        if data is not None and len(remote) < missing:
+                            remote[sid] = data
+                if len(remote) < missing and self.fetch_remote is not None:
+                    for sid in want_remote:
+                        if sid in remote:
+                            continue
+                        if len(remote) >= missing:
+                            break
+                        data = self.fetch_remote(sid, offset, size)
+                        if data is not None:
+                            remote[sid] = data
+            merged = {**local, **remote}
             bufs: list[np.ndarray] = []
             rows: list[int] = []
-            for sid in range(gf.TOTAL_SHARDS):
-                if sid == want_sid or len(rows) == gf.DATA_SHARDS:
-                    continue
-                data: bytes | None = None
-                f = self.shards.get(sid)
-                if f is not None:
-                    raw = os.pread(f.fileno(), size, offset)
-                    data = raw + b"\x00" * (size - len(raw))
-                elif self.fetch_remote is not None:
-                    data = self.fetch_remote(sid, offset, size)
-                if data is not None:
-                    rows.append(sid)
-                    bufs.append(np.frombuffer(data, np.uint8))
+            for sid in sorted(merged):
+                if len(rows) == gf.DATA_SHARDS:
+                    break
+                rows.append(sid)
+                bufs.append(np.frombuffer(merged[sid], np.uint8))
             sp.set("shards", list(rows))
             if len(rows) < gf.DATA_SHARDS:
                 raise EcVolumeError(

@@ -9,17 +9,19 @@ needle engine. BENCH_NEEDLE.md measured the same here: the engine does
 caps the served rate at ~3.8k/s on this single core.
 
 This module is a raw `asyncio.Protocol` that parses just enough HTTP
-for the two hot shapes — `GET /<vid>,<fid>` and `POST/PUT /<vid>,<fid>`
-with a raw body — and answers them with preformatted header bytes.
-EVERYTHING else (cold routes, conditional headers, multipart, chunked
-manifests, gzip, JWT, replication fan-out, redirects, resize) is handed
-to the full aiohttp application by swapping the connection's protocol
-in place (`transport.set_protocol`), so those requests keep byte-for-
-byte the semantics of the existing handlers; the swap preserves the
-real peer address, so IP guards keep working. A connection that leaves
-the fast path stays on aiohttp for its lifetime — per-connection state
-stays trivially simple and benchmark/data-plane connections never pay
-for it.
+for the hot shapes — `GET/POST/PUT/DELETE /<vid>,<fid>` and the
+multi-needle `GET /batch?fids=...` — and hands them to the UNIFIED wire
+layer (server/wire.py), the same parse/handle/respond code the aiohttp
+listener uses, then renders the WireResponse as preformatted bytes.
+Cold needle bodies go disk->socket with `loop.sendfile` (zero-copy;
+`source=sendfile` in the trace). EVERYTHING the shared layer marks
+`upgrade` (chunked-manifest assembly, multipart, JWT'd writes,
+sibling-owned volumes) is handed to the full aiohttp application by
+swapping the connection's protocol in place (`transport.set_protocol`),
+so those requests keep byte-for-byte the semantics of the full
+handlers; the swap preserves the real peer address, so IP guards keep
+working. A connection that leaves the fast path stays on aiohttp for
+its lifetime.
 """
 
 from __future__ import annotations
@@ -27,29 +29,16 @@ from __future__ import annotations
 import asyncio
 import json
 import re
-import time
 
-from ..storage import types as t
-from ..storage.backend import BackendError
-from ..storage.needle import (FLAG_HAS_LAST_MODIFIED, CrcMismatch, Needle,
-                              NeedleError)
-from ..storage.volume import AlreadyDeleted, NotFound, VolumeError
-from ..ec.ec_volume import EcVolumeError
 from ..util import tracing
-from ..util.failpoints import (FailpointDrop, FailpointError,
-                               pending as _fp_pending)
-
-# context-propagating executor hop (store spans parent correctly)
-_traced_executor = tracing.run_in_executor
+from ..util.failpoints import pending as _fp_pending
+from . import wire
 
 _REQ_LINE = re.compile(
-    rb"^(GET|POST|PUT) /(\d+,[0-9a-fA-F]+)((?:\?[^ ]*)?) HTTP/1\.1$")
+    rb"^(GET|POST|PUT|DELETE) /(\d+,[0-9a-fA-F]+)"
+    rb"((?:\?[^ ]*)?) HTTP/1\.1$")
+_BATCH_LINE = re.compile(rb"^GET /batch((?:\?[^ ]*)?) HTTP/1\.1$")
 
-# preformatted cold responses
-_R404 = (b"HTTP/1.1 404 Not Found\r\nContent-Length: 0\r\n\r\n")
-_R404_VOL = (b"HTTP/1.1 404 Not Found\r\n"
-             b"Content-Type: application/json; charset=utf-8\r\n"
-             b"Content-Length: 22\r\n\r\n{\"error\": \"not found\"}")
 _R401_BODY = b"{\"error\": \"ip not in whitelist\"}"
 # built from len(): a hand-counted Content-Length that disagrees with
 # the body desyncs every spec-conformant keep-alive client
@@ -57,23 +46,6 @@ _R401_IP = (b"HTTP/1.1 401 Unauthorized\r\n"
             b"Content-Type: application/json; charset=utf-8\r\n"
             b"Content-Length: " + str(len(_R401_BODY)).encode()
             + b"\r\n\r\n" + _R401_BODY)
-_R400 = (b"HTTP/1.1 400 Bad Request\r\nContent-Length: 0\r\n\r\n")
-
-# tiny cache of formatted Last-Modified values: needles written in the
-# same second share the string, and strftime is the priciest call left
-# on the read path
-_LM_CACHE: dict[int, bytes] = {}
-
-
-def _http_date(ts: int) -> bytes:
-    v = _LM_CACHE.get(ts)
-    if v is None:
-        v = time.strftime("%a, %d %b %Y %H:%M:%S GMT",
-                          time.gmtime(ts)).encode()
-        if len(_LM_CACHE) > 64:
-            _LM_CACHE.clear()
-        _LM_CACHE[ts] = v
-    return v
 
 
 def _json_err(status: int, reason: str, msg: str) -> bytes:
@@ -82,6 +54,25 @@ def _json_err(status: int, reason: str, msg: str) -> bytes:
             b"Content-Type: application/json; charset=utf-8\r\n"
             b"Content-Length: %d\r\n\r\n"
             % (status, reason.encode(), len(body))) + body
+
+
+def _parse_query(q: bytes) -> dict | None:
+    """Simple query bytes -> dict; None when the full parser must take
+    over (%-escapes, '+' spaces)."""
+    if q in (b"", b"?"):
+        return {}
+    if b"%" in q or b"+" in q:
+        return None
+    out: dict = {}
+    for kv in q[1:].split(b"&"):
+        if not kv:
+            continue
+        k, _, v = kv.partition(b"=")
+        try:
+            out[k.decode("ascii")] = v.decode("ascii")
+        except UnicodeDecodeError:
+            return None
+    return out
 
 
 class FastNeedleProtocol(asyncio.Protocol):
@@ -137,27 +128,46 @@ class FastNeedleProtocol(asyncio.Protocol):
                     self._upgrade()      # oversized header block: not ours
                 return
             line_end = self.buf.find(b"\r\n")
-            m = _REQ_LINE.match(bytes(self.buf[:line_end]))
-            if m is None:
+            req_line = bytes(self.buf[:line_end])
+            m = _REQ_LINE.match(req_line)
+            bm = None if m else _BATCH_LINE.match(req_line)
+            if m is None and bm is None:
                 self._upgrade()
                 return
             headers = self._parse_headers(head_end, line_end)
             if headers is None:
                 self._upgrade()
                 return
-            method = m.group(1)
-            if method == b"GET":
-                if m.group(3) not in (b"", b"?") or (
-                        headers.keys() & {"range", "if-none-match",
-                                          "if-modified-since", "etag-md5"}):
+            if bm is not None:
+                query = _parse_query(bm.group(1))
+                if query is None:
                     self._upgrade()
                     return
-                fid_s = m.group(2).decode()
                 del self.buf[:head_end + 4]
-                self._spawn(self._do_get(fid_s, headers))
+                self._spawn(self._do_batch(query, headers))
+                return
+            method = m.group(1)
+            query = _parse_query(m.group(3))
+            if query is None:
+                self._upgrade()
+                return
+            fid_s = m.group(2).decode()
+            if method == b"GET":
+                del self.buf[:head_end + 4]
+                self._spawn(self._do_get(fid_s, m.group(3), headers,
+                                         query))
+                return
+            if method == b"DELETE":
+                if headers.get("content-length", "0") != "0" \
+                        or "transfer-encoding" in headers:
+                    self._upgrade()  # bodied DELETE: full parser's job
+                    return
+                del self.buf[:head_end + 4]
+                self._spawn(self._do_delete(fid_s, m.group(3), headers,
+                                            query))
                 return
             # POST/PUT
-            if not self._write_is_fast(m, headers):
+            if not self._write_is_fast(headers):
                 self._upgrade()
                 return
             clen = int(headers.get("content-length", "0"))
@@ -165,9 +175,9 @@ class FastNeedleProtocol(asyncio.Protocol):
             if len(self.buf) < total:
                 return               # body still in flight
             body = bytes(self.buf[head_end + 4:total])
-            fid_s = m.group(2).decode()
             del self.buf[:total]
-            self._spawn(self._do_post(fid_s, m.group(3), headers, body))
+            self._spawn(self._do_post(fid_s, m.group(3), headers, query,
+                                      body))
             return
 
     def _spawn(self, coro) -> None:
@@ -196,10 +206,10 @@ class FastNeedleProtocol(asyncio.Protocol):
             self.transport.close()
 
     def _parse_headers(self, head_end: int, line_end: int
-                       ) -> dict[str, str] | None:
+                       ) -> dict | None:
         """Lower-cased header dict, or None when the block needs the
         full parser (duplicates, continuations, anything malformed)."""
-        headers: dict[str, str] = {}
+        headers: dict = {}
         block = bytes(self.buf[line_end + 2:head_end])
         if not block:
             return headers
@@ -216,237 +226,186 @@ class FastNeedleProtocol(asyncio.Protocol):
                 return None
         return headers
 
-    def _write_is_fast(self, m, headers: dict[str, str]) -> bool:
+    def _write_is_fast(self, headers: dict) -> bool:
+        """Writes the shared wire layer can take straight from a raw
+        body; the rest (JWT checks, multipart parse, replica-framed
+        bodies, chunked uploads) go to the full aiohttp handler."""
         vs = self.vs
         if vs.jwt_key:
             return False             # token checks stay with aiohttp
-        q = m.group(3)
-        if q not in (b"", b"?"):
-            # only ts/ttl are understood here; cm/type/etc go cold
-            for kv in q[1:].split(b"&"):
-                if kv and kv.split(b"=")[0] not in (b"ts", b"ttl"):
-                    return False
         if "transfer-encoding" in headers or "expect" in headers:
             return False
         clen = headers.get("content-length")
         if clen is None or not clen.isdigit() or int(clen) > (4 << 20):
             return False
-        ctype = headers.get("content-type", "")
-        if ctype.startswith("multipart/") or ctype.startswith("image/jp"):
-            return False             # multipart parse / EXIF fix: cold
+        if headers.get("content-type", "").startswith("multipart/"):
+            return False             # multipart parse: cold
         if "x-raw-needle" in headers:
             return False             # replica write framing: cold
-        for k in headers:
-            if k.startswith("seaweed-"):
-                return False         # pair headers: cold
         return True
 
-    # -- fast handlers --
+    def _worker_hop(self, headers: dict) -> bool:
+        wc = self.vs.worker_ctx
+        return wc is not None and \
+            wc.token_ok(headers.get("x-swtpu-worker"))
 
-    async def _do_get(self, fid_s: str, headers: dict[str, str]) -> None:
+    def _wire_request(self, method: str, fid_s: str, query: dict,
+                      headers: dict, body: bytes | None = None
+                      ) -> wire.WireRequest:
+        return wire.WireRequest(
+            method=method, fid_s=fid_s, query=query, headers=headers,
+            peer_ip=self.peer_ip, body=body, raw=True,
+            worker_hop=self._worker_hop(headers))
+
+    # -- fast handlers (adapters over server/wire.py) --
+
+    async def _do_get(self, fid_s: str, q: bytes, headers: dict,
+                      query: dict) -> None:
         vs = self.vs
-        out: bytes
-        body = b""
-        try:
-            fid = t.FileId.parse(fid_s)
-        except ValueError as e:
-            self._finish(_json_err(400, "Bad Request", str(e)))
-            return
-        wc = vs.worker_ctx
-        if wc is not None and not wc.owns(fid.volume_id):
-            # a sibling worker's partition: replay through aiohttp,
-            # whose worker-routing middleware proxies to the owner
-            self._upgrade_replay(b"GET", fid_s, headers)
-            return
-        if not vs.store.has_volume(fid.volume_id):
-            if vs.read_redirect:
-                self._upgrade_replay(b"GET", fid_s, headers)
-                return
-            self._finish(_R404_VOL)
-            return
+        wr = self._wire_request("GET", fid_s, query, headers)
         # volume-tier entry span for the fast path; a request that
         # replays into aiohttp cancels it (the full handler's
         # middleware records its own, joined to the same traceparent)
         sp = tracing.start_root("volume", "read", headers=headers)
         with sp:
-            # hot-needle cache peek first: a hit answers on the event
-            # loop with zero disk I/O and no executor round-trip — the
-            # dominant per-request cost left on this path
-            # (BENCH_NEEDLE.md). count=False: whether this lookup
-            # counts depends on what the needle turns out to be — a
-            # pairs/gzip/manifest needle replays through aiohttp,
-            # which does its own (single) accounting
-            n = vs.store.cached_needle(fid.volume_id, fid.key,
-                                       fid.cookie, count=False)
-            from_cache = n is not None
-            try:
-                if n is None:
-                    n = await _traced_executor(
-                        vs.store.read_needle,
-                        fid.volume_id, fid.key, fid.cookie)
-            except (NotFound, AlreadyDeleted):
-                vs.count("read", "404")
-                sp.status = "404"
-                self._finish(_R404)
-                return
-            except CrcMismatch as e:
-                sp.status = "500"
-                self._finish(_json_err(500, "Internal Server Error",
-                                       str(e)))
-                return
-            except (EcVolumeError, BackendError) as e:
-                vs.count("read", "error")
-                sp.status = "503"
-                self._finish(_json_err(503, "Service Unavailable",
-                                       str(e)))
-                return
-            except FailpointDrop:
-                # injected connection drop: sever, don't answer
-                sp.status = "drop"
-                self._closed = True
-                self._busy = False
-                self.transport.close()
-                return
-            except FailpointError as e:
-                sp.status = str(e.status)
-                self._finish(_json_err(e.status, "Injected Error",
-                                       str(e)))
-                return
-            except Exception as e:  # noqa: BLE001 — keep conn coherent
-                sp.status = "500"
-                self._finish(_json_err(500, "Internal Server Error",
-                                       str(e)))
-                return
-            if n.pairs or n.is_chunked_manifest or n.is_gzipped:
-                # pairs->headers / manifest assembly / gzip negotiation:
-                # re-serve this request through the full handler (which
-                # counts the cache hit/miss for this request itself)
+            resp = await wire.serve_read(vs, wr)
+            if resp.upgrade:
                 sp.cancel()
-                self._upgrade_replay(b"GET", fid_s, headers)
+                self._upgrade_replay(b"GET", fid_s, headers, q)
                 return
-            if from_cache:
-                # deferred accounting for the served fast-path hit
-                vs.store.needle_cache.hit(n)
-                sp.set("source", "cache")
-            vs.count("read", "ok")
-            sp.nbytes = len(n.data)
-            body = n.data
-        ct = n.mime.decode() if n.mime else "application/octet-stream"
-        extra = b""
-        if n.name:
-            from .volume_server import _guess_mime
-            fname = n.name.decode(errors="replace")
-            if not n.mime:
-                ct = _guess_mime(fname, ct)
-            fname = "".join(c for c in fname if c >= " ")
-            esc = fname.replace("\\", "\\\\").replace('"', '\\"')
-            extra += (b"Content-Disposition: inline; filename=\""
-                      + esc.encode() + b"\"\r\n")
-        if n.last_modified:
-            extra += (b"Last-Modified: " + _http_date(int(n.last_modified))
-                      + b"\r\n")
-        out = (b"HTTP/1.1 200 OK\r\nContent-Type: " + ct.encode()
-               + b"\r\nContent-Length: " + str(len(body)).encode()
-               + b"\r\nEtag: \"" + n.etag().encode()
-               + b"\"\r\nAccept-Ranges: bytes\r\n" + extra + b"\r\n")
-        if len(body) < 64 * 1024:
-            self._finish(out + body)       # one syscall for small reads
-        else:
-            self._finish(out, body)
+            sp.status = "ok" if resp.status < 400 else str(resp.status)
+            await self._respond(resp)
 
-    async def _do_post(self, fid_s: str, q: bytes,
-                       headers: dict[str, str], body: bytes) -> None:
+    async def _do_post(self, fid_s: str, q: bytes, headers: dict,
+                       query: dict, body: bytes) -> None:
         vs = self.vs
-        wc = vs.worker_ctx
+        wr = self._wire_request("POST", fid_s, query, headers, body)
         # an intra-host worker hop carries the launch token: the entry
         # worker already ran the guard against the real client IP
-        proxied_hop = wc is not None and \
-            wc.token_ok(headers.get("x-swtpu-worker"))
-        if not proxied_hop and not vs.guard.empty \
+        if not wr.worker_hop and not vs.guard.empty \
                 and not vs.guard.allows(self.peer_ip):
             self._finish(_R401_IP)
             return
-        try:
-            fid = t.FileId.parse(fid_s)
-        except ValueError as e:
-            self._finish(_json_err(400, "Bad Request", str(e)))
-            return
-        if wc is not None and not wc.owns(fid.volume_id):
+        wc = vs.worker_ctx
+        vid_s = fid_s.split(",", 1)[0]
+        if wc is not None and not wr.worker_hop \
+                and not wc.owns(int(vid_s)):
             self._upgrade_replay(b"POST", fid_s, headers, q, body)
             return
-        # replication fan-out stays with aiohttp: decide BEFORE writing
-        v = vs.store.volumes.get(fid.volume_id)
-        if v is not None:
-            rp = v.super_block.replica_placement
-            if rp and rp.copy_count > 1:
+        with tracing.start_root("volume", "write", headers=headers) as sp:
+            resp = await wire.serve_write(vs, wr)
+            if resp.upgrade:
+                sp.cancel()
                 self._upgrade_replay(b"POST", fid_s, headers, q, body)
                 return
-        ts_s = ttl_s = ""
-        if q not in (b"", b"?"):
-            for kv in q[1:].split(b"&"):
-                k, _, val = kv.partition(b"=")
-                if k == b"ts":
-                    ts_s = val.decode()
-                elif k == b"ttl":
-                    ttl_s = val.decode()
-        ctype = headers.get("content-type", "")
-        mime = b""
-        if ctype and ctype != "application/octet-stream":
-            mime = ctype.split(";")[0].encode()
-        try:
-            last_modified = int(ts_s or time.time())
-        except ValueError:
-            last_modified = int(time.time())
-        if not 0 <= last_modified < (1 << 40):
-            last_modified = int(time.time())
-        try:
-            n = Needle(cookie=fid.cookie, id=fid.key, data=body, mime=mime,
-                       ttl=t.TTL.parse(ttl_s), last_modified=last_modified)
-        except (NeedleError, ValueError) as e:
-            self._finish(_json_err(400, "Bad Request", str(e)))
+            sp.status = "ok" if resp.status < 400 else str(resp.status)
+            await self._respond(resp)
+
+    async def _do_delete(self, fid_s: str, q: bytes, headers: dict,
+                         query: dict) -> None:
+        vs = self.vs
+        wr = self._wire_request("DELETE", fid_s, query, headers)
+        if vs.jwt_key:
+            # token checks stay with aiohttp (shared guard exemptions)
+            self._upgrade_replay(b"DELETE", fid_s, headers, q)
             return
-        n.set_flag(FLAG_HAS_LAST_MODIFIED)
-        with tracing.start_root("volume", "write", headers=headers) as sp:
+        if not wr.worker_hop and not vs.guard.empty \
+                and not vs.guard.allows(self.peer_ip):
+            self._finish(_R401_IP)
+            return
+        wc = vs.worker_ctx
+        vid_s = fid_s.split(",", 1)[0]
+        if wc is not None and not wr.worker_hop \
+                and not wc.owns(int(vid_s)):
+            self._upgrade_replay(b"DELETE", fid_s, headers, q)
+            return
+        with tracing.start_root("volume", "delete",
+                                headers=headers) as sp:
+            resp = await wire.serve_delete(vs, wr)
+            sp.status = "ok" if resp.status < 400 else str(resp.status)
+            await self._respond(resp)
+
+    async def _do_batch(self, query: dict, headers: dict) -> None:
+        vs = self.vs
+        wr = self._wire_request("GET", "", query, headers)
+        with tracing.start_root("volume", "batch",
+                                headers=headers) as sp:
+            resp = await wire.serve_batch(vs, wr)
+            sp.status = "ok" if resp.status < 400 else str(resp.status)
+            await self._respond(resp)
+
+    # -- response rendering --
+
+    def _encode_head(self, resp: wire.WireResponse) -> bytes:
+        out = [b"HTTP/1.1 %d %s\r\n"
+               % (resp.status, wire.reason(resp.status).encode())]
+        body_len = (len(resp.body) if resp.truncate_to >= 0
+                    else resp.content_length)
+        if not resp.head or resp.status not in (301, 304):
+            out.append(b"Content-Type: "
+                       + resp.content_type.encode() + b"\r\n")
+        out.append(b"Content-Length: " + str(body_len).encode()
+                   + b"\r\n")
+        for k, v in resp.headers.items():
+            out.append(k.encode("latin-1") + b": "
+                       + str(v).encode("latin-1") + b"\r\n")
+        out.append(b"\r\n")
+        return b"".join(out)
+
+    async def _respond(self, resp: wire.WireResponse) -> None:
+        if resp.drop:
+            # injected connection drop: sever, don't answer
+            self._closed = True
+            self._busy = False
+            self.transport.close()
+            return
+        if resp.truncate_to >= 0:
+            # failpoint truncate: full Content-Length, partial body,
+            # dead socket — the mid-read death degraded reads survive
+            if not self._closed:
+                self.transport.write(self._encode_head(resp))
+                self.transport.write(resp.body[:resp.truncate_to])
+            self._closed = True
+            self._busy = False
+            self.transport.close()
+            return
+        if resp.sendfile is not None:
+            await self._respond_sendfile(resp)
+            return
+        head = self._encode_head(resp)
+        if resp.head or not resp.body:
+            self._finish(head)
+        elif len(resp.body) < 64 * 1024:
+            self._finish(head + resp.body)  # one syscall for small reads
+        else:
+            self._finish(head, resp.body)
+
+    async def _respond_sendfile(self, resp: wire.WireResponse) -> None:
+        """Zero-copy body: headers via transport.write, then the needle
+        data region goes disk->socket with loop.sendfile (kernel copy;
+        asyncio falls back to executor-chunked reads where sendfile is
+        unavailable, e.g. TLS transports)."""
+        ref = resp.sendfile
+        try:
+            if self._closed:
+                return
+            self.transport.write(self._encode_head(resp))
             try:
-                _, size = await _traced_executor(
-                    vs.store.write_needle, fid.volume_id, n)
-            except NotFound:
-                sp.status = "404"
-                self._finish(_json_err(404, "Not Found",
-                                       "volume not found"))
-                return
-            except NeedleError as e:
-                sp.status = "400"
-                self._finish(_json_err(400, "Bad Request", str(e)))
-                return
-            except VolumeError as e:
-                sp.status = "409"
-                self._finish(_json_err(409, "Conflict", str(e)))
-                return
-            except FailpointDrop:
-                sp.status = "drop"
+                await asyncio.get_running_loop().sendfile(
+                    self.transport, ref.file, ref.offset, ref.length,
+                    fallback=True)
+            except (OSError, RuntimeError):
+                # mid-send failure: the declared Content-Length can no
+                # longer be honored — sever so the client sees a short
+                # body, exactly like a buffered write tear
                 self._closed = True
-                self._busy = False
                 self.transport.close()
                 return
-            except FailpointError as e:
-                sp.status = str(e.status)
-                self._finish(_json_err(e.status, "Injected Error",
-                                       str(e)))
-                return
-            except Exception as e:  # noqa: BLE001
-                sp.status = "500"
-                self._finish(_json_err(500, "Internal Server Error",
-                                       str(e)))
-                return
-            sp.nbytes = len(body)
-        vs.count("write", "ok")
-        rbody = (b"{\"name\": \"\", \"size\": " + str(size).encode()
-                 + b", \"eTag\": \"" + n.etag().encode() + b"\"}")
-        self._finish(b"HTTP/1.1 201 Created\r\n"
-                     b"Content-Type: application/json; charset=utf-8\r\n"
-                     b"Content-Length: " + str(len(rbody)).encode()
-                     + b"\r\n\r\n" + rbody)
+        finally:
+            ref.close()
+        self._busy = False
+        if self.buf and not self._closed:
+            self._pump()
 
     # -- plumbing --
 
@@ -475,7 +434,7 @@ class FastNeedleProtocol(asyncio.Protocol):
             proto.data_received(raw)
 
     def _upgrade_replay(self, method: bytes, fid_s: str,
-                        headers: dict[str, str], q: bytes = b"",
+                        headers: dict, q: bytes = b"",
                         body: bytes = b"") -> None:
         """Upgrade when the fast path discovered mid-request that the
         full handler must serve it: reconstruct the consumed request at
@@ -582,6 +541,7 @@ class FastAssignProtocol(asyncio.Protocol):
             rp = ReplicaPlacement.parse(repl)
         except ValueError as e:
             return _json_err(400, "Bad Request", str(e))
+        from ..storage import types as t
         lay = ms._layout(coll, repl, ttl_s)
         vid = lay.pick_for_write(ms.topo, rp.copy_count)
         if vid is None:

@@ -25,38 +25,15 @@ from ..ec import gf
 from ..ec import pipeline as ecpl
 from ..ec.ec_volume import EcVolumeError
 from ..pb import messages as pb
-from ..util import failpoints, glog, tracing
+from ..util import batchframe, failpoints, glog, tracing
 from ..util.resilience import BreakerRegistry
 from ..storage import types as t
-from ..storage.needle import (FLAG_GZIP, FLAG_HAS_LAST_MODIFIED,
-                              FLAG_IS_CHUNK_MANIFEST, CrcMismatch, Needle,
-                              NeedleError)
+from ..storage.needle import CrcMismatch, Needle, NeedleError
 from ..storage.backend import BackendError
 from ..storage.store import Store
 from ..storage.volume import AlreadyDeleted, NotFound, VolumeError
 from ..security import tls
-
-
-def _disposition(req: "web.Request", fname: str) -> str:
-    """Content-Disposition value with ?dl=true attachment support
-    (writeResponseContent, volume_server_handlers_read.go:239-247).
-    Control characters are stripped — a CR/LF in a stored name would
-    otherwise kill the response in the header serializer."""
-    fname = "".join(ch for ch in fname if ch >= " ")
-    disp = ("attachment"
-            if req.query.get("dl", "").lower() in ("1", "true")
-            else "inline")
-    escaped = fname.replace("\\", "\\\\").replace('"', '\\"')
-    return f'{disp}; filename="{escaped}"'
-
-
-def _guess_mime(fname: str, default: str) -> str:
-    """Extension-derived mime, ONLY for plain extensions: guess_type
-    splits 'a.tar.gz' into (application/x-tar, gzip) and serving the
-    inner type for compressed bytes would mislabel the body."""
-    import mimetypes
-    guess, enc = mimetypes.guess_type(fname)
-    return guess if guess and enc is None else default
+from . import wire
 
 
 def _wk():
@@ -88,12 +65,20 @@ class VolumeServer:
                  jwt_key: str = "",
                  white_list: list[str] | None = None,
                  public_url: str = "",
-                 worker_ctx=None):
+                 worker_ctx=None,
+                 batch_max: int = wire.BATCH_MAX_DEFAULT,
+                 sendfile_min: int = wire.SENDFILE_MIN):
         # -workers N process-per-core mode (server/workers.py): this
         # server is worker `ctx.index` of `ctx.total`, sharing the
         # public port via SO_REUSEPORT and owning vids % total == index
         self.worker_ctx = worker_ctx
         self.public_url = public_url
+        # unified-wire knobs: most fids per /batch request (-batch.max),
+        # the buffered-response byte budget one batch may hold, and the
+        # zero-copy floor for raw-listener cold reads
+        self.batch_max = batch_max
+        self.batch_bytes_max = 64 << 20
+        self.sendfile_min = sendfile_min
         from ..security.guard import Guard
         # -whiteList (volume.go:87,125): IP guard over the admin surface
         # and needle writes; reads stay open like the reference's public
@@ -124,6 +109,10 @@ class VolumeServer:
             threshold=3, reset_timeout=2.0)
         from .ec_locations import EcLocationCache
         self._ec_locations = EcLocationCache(self._lookup_ec_locations)
+        # shared keep-alive pool for SYNC (executor-thread) shard/meta
+        # fetches — one handshake per holder, not one per interval
+        from ..util.connpool import SyncHttpPool
+        self._sync_pool = SyncHttpPool(timeout=30.0)
         self.app = self._build_app()
         store.fetch_remote_shard = None  # wired after start (needs loop)
 
@@ -171,6 +160,8 @@ class VolumeServer:
         p = req.path
         if _FID_PATH.match(p):
             op = self._TRACE_OPS.get(req.method, req.method.lower())
+        elif p == "/batch":
+            op = "batch"
         elif p in self._traced_admin:
             op = p[len("/admin/"):].replace("/", ".")
         else:
@@ -285,6 +276,10 @@ class VolumeServer:
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_get("/stats/workers", self.h_stats_workers)
         app.router.add_get("/ui", self.h_ui)
+        # pipelined multi-needle GET (unified wire batch path); POST
+        # form carries long fid lists as a JSON body
+        app.router.add_get("/batch", self.h_batch)
+        app.router.add_post("/batch", self.h_batch)
         # public needle API — catch-all LAST
         app.router.add_route("GET", "/{fid:[^/]+}", self.h_get)
         app.router.add_route("HEAD", "/{fid:[^/]+}", self.h_get)
@@ -349,8 +344,11 @@ class VolumeServer:
         if wc is not None:
             wc.write_state(ip=self.ip, port=self.port, role="volume")
         # remote EC shard reads run inside executor threads, so they use a
-        # synchronous client (readRemoteEcShardInterval, store_ec.go:211+)
+        # synchronous client (readRemoteEcShardInterval, store_ec.go:211+);
+        # the batched form gathers one request per holder
         self.store.fetch_remote_shard = self._sync_fetch_remote_shard
+        self.store.fetch_remote_shard_batch = \
+            self._sync_fetch_remote_shard_batch
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
 
     async def stop(self) -> None:
@@ -368,6 +366,7 @@ class VolumeServer:
             self._priv_server.close()
         if self._runner:
             await self._runner.cleanup()
+        self._sync_pool.close()
         self.store.close()
 
     _counters: dict = None  # type: ignore[assignment]
@@ -386,25 +385,26 @@ class VolumeServer:
         c.inc()
 
     def _lookup_ec_locations(self, vid: int) -> dict | None:
-        """One master /vol/ec_lookup call (executor threads only)."""
+        """One master /vol/ec_lookup call (executor threads only),
+        over the shared keep-alive pool."""
         import json as _json
-        import urllib.request
-        with urllib.request.urlopen(
-                tls.url(self.master_url, f"/vol/ec_lookup?volumeId={vid}"),
-                timeout=10, context=tls.client_ctx()) as r:
-            return _json.load(r)["shards"]
+        failpoints.sync_fail("volume.ec_fetch")
+        status, body = self._sync_pool.request(
+            self.master_url, f"/vol/ec_lookup?volumeId={vid}")
+        if status != 200:
+            raise OSError(f"ec_lookup {vid}: http {status}")
+        return _json.loads(body)["shards"]
 
     def _sync_fetch_remote_shard(self, vid: int, shard_id: int,
                                  offset: int, size: int) -> bytes | None:
         """Blocking remote shard interval fetch; locations come from the
         staleness-tiered cache (store_ec.go:218-259) so a degraded-read
-        burst costs one master lookup, not one per interval."""
-        import urllib.request
-        from http.client import HTTPException
+        burst costs one master lookup, not one per interval, and the
+        connection comes from the shared keep-alive pool so it costs
+        one handshake per holder, not one per interval."""
         shards = self._ec_locations.get(vid)
         if shards is None:
             return None
-        ctx = tls.client_ctx()
         # runs inside the executor thread whose context the read path
         # copied in, so the store span is current here — stamping the
         # traceparent keeps the remote holder's shard_read span in THIS
@@ -417,25 +417,19 @@ class VolumeServer:
                 continue
             attempted = True
             try:
-                with urllib.request.urlopen(
-                        urllib.request.Request(
-                            tls.url(target,
-                                    f"/admin/ec/shard_read?volume={vid}"
-                                    f"&shard={shard_id}&offset={offset}"
-                                    f"&size={size}"),
-                            headers=trace_headers),
-                        timeout=30, context=ctx) as r:
-                    data = r.read()
-                    if len(data) == size:
-                        return data
-                    glog.warning("remote ec shard %d.%d from %s: short "
-                                 "read %d/%d", vid, shard_id, target,
-                                 len(data), size)
-            except (OSError, ValueError, HTTPException) as e:
-                # OSError covers urllib's URLError/HTTPError and socket
-                # timeouts; HTTPException covers a holder dying
-                # mid-body (IncompleteRead, RemoteDisconnected). A
-                # swallowed holder failure must be visible.
+                failpoints.sync_fail("volume.ec_fetch")
+                status, data = self._sync_pool.request(
+                    target, f"/admin/ec/shard_read?volume={vid}"
+                            f"&shard={shard_id}&offset={offset}"
+                            f"&size={size}", headers=trace_headers)
+                if status == 200 and len(data) == size:
+                    return data
+                glog.warning("remote ec shard %d.%d from %s: http %d, "
+                             "%d/%d bytes", vid, shard_id, target,
+                             status, len(data), size)
+            except OSError as e:
+                # PoolError/timeouts: a swallowed holder failure must
+                # be visible
                 glog.warning("remote ec shard %d.%d from %s: %s",
                              vid, shard_id, target, e)
                 continue
@@ -446,6 +440,56 @@ class VolumeServer:
             # the caller reconstructs instead.
             self._ec_locations.invalidate(vid)
         return None
+
+    def _sync_fetch_remote_shard_batch(
+            self, vid: int, reads: "list[tuple[int, int, int]]"
+            ) -> "dict[int, bytes] | None":
+        """Batched remote shard gather for the recover path: group the
+        wanted (shard, offset, size) intervals by HOLDER and issue one
+        `/admin/ec/shard_read?reads=...` per holder — the k-fetch
+        network fan-out of a degraded read collapses to one round trip
+        per surviving server (arxiv 1309.0186's recovery-cost shape)."""
+        shards = self._ec_locations.get(vid)
+        if shards is None:
+            return None
+        by_holder: dict[str, list[tuple[int, int, int]]] = {}
+        for sid, off, size in reads:
+            for target in shards.get(str(sid), []):
+                if target != self.url:
+                    by_holder.setdefault(target, []).append(
+                        (sid, off, size))
+                    break
+        if not by_holder:
+            return None
+        trace_headers: dict = {}
+        tracing.inject(trace_headers)
+        out: dict[int, bytes] = {}
+        failed = False
+        for target, group in by_holder.items():
+            spec = ",".join(f"{sid}:{off}:{size}"
+                            for sid, off, size in group)
+            try:
+                failpoints.sync_fail("volume.ec_fetch")
+                status, body = self._sync_pool.request(
+                    target, f"/admin/ec/shard_read?volume={vid}"
+                            f"&reads={spec}", headers=trace_headers)
+                if status != 200:
+                    raise OSError(f"http {status}")
+                rows = batchframe.parse_all(body)
+            except (OSError, ValueError) as e:
+                glog.warning("batched ec gather %d from %s (%d "
+                             "intervals): %s", vid, target,
+                             len(group), e)
+                failed = True
+                continue
+            for (sid, _, size), (meta, data) in zip(group, rows):
+                if meta.get("status") == 200 and len(data) == size:
+                    out[sid] = data
+                else:
+                    failed = True
+        if failed:
+            self._ec_locations.invalidate(vid)
+        return out or None
 
     # ---- heartbeat loop ----
 
@@ -528,176 +572,78 @@ class VolumeServer:
             await asyncio.sleep(
                 self.pulse_seconds * random.uniform(0.8, 1.2))
 
-    # ---- public needle handlers ----
+    # ---- public needle handlers (adapters over server/wire.py) ----
 
     @staticmethod
     def _parse_fid(fid: str) -> t.FileId:
         return t.FileId.parse(fid)
 
-    async def h_get(self, req: web.Request) -> web.Response:
-        try:
-            fid = self._parse_fid(req.match_info["fid"])
-        except ValueError as e:
-            return web.json_response({"error": str(e)}, status=400)
-        if not self.store.has_volume(fid.volume_id):
-            if not self.read_redirect:
-                return web.json_response({"error": "not found"}, status=404)
-            # misrouted read: redirect via master lookup (handlers_read.go:46)
-            async with self._http.get(
-                    tls.url(self.master_url, "/dir/lookup"),
-                    params={"volumeId": str(fid.volume_id)}) as resp:
-                if resp.status != 200:
-                    return web.json_response({"error": "volume not found"},
-                                             status=404)
-                locs = (await resp.json())["locations"]
-            others = [l for l in locs if l["url"] != self.url]
-            if not others:
-                return web.json_response({"error": "volume not found"},
-                                         status=404)
-            raise web.HTTPMovedPermanently(
-                tls.url(others[0]['publicUrl'], f"/{req.match_info['fid']}"))
-        from ..stats import metrics
-        try:
-            t0 = time.perf_counter()
-            # hot-needle cache peek: a hit answers on the event loop;
-            # misses pay the executor round-trip for disk (and possibly
-            # remote-shard) I/O
-            n = self.store.cached_needle(fid.volume_id, fid.key,
-                                         fid.cookie)
-            if n is not None:
-                tracing.current().set("source", "cache")
-            else:
-                n = await self._in_executor(
-                    self.store.read_needle,
-                    fid.volume_id, fid.key, fid.cookie)
-            if metrics.HAVE_PROMETHEUS:
-                metrics.VOLUME_REQUEST_TIME.labels("read").observe(
-                    time.perf_counter() - t0)
-                metrics.VOLUME_REQUEST_COUNTER.labels("read", "ok").inc()
-        except (NotFound, AlreadyDeleted):
-            if metrics.HAVE_PROMETHEUS:
-                metrics.VOLUME_REQUEST_COUNTER.labels("read", "404").inc()
-            return web.Response(status=404)
-        except failpoints.FailpointDrop:
+    def _wire_request(self, req: web.Request,
+                      body: bytes | None = None) -> wire.WireRequest:
+        return wire.WireRequest(
+            method=req.method, fid_s=req.match_info.get("fid", ""),
+            query=dict(req.query),
+            headers={k.lower(): v for k, v in req.headers.items()},
+            peer_ip=req.remote, body=body, raw=False,
+            worker_hop=self._is_worker_hop(req))
+
+    async def _wire_response(self, req: web.Request,
+                             resp: wire.WireResponse
+                             ) -> web.StreamResponse:
+        """Render a WireResponse through aiohttp — the transport-level
+        twin of the raw listener's byte renderer."""
+        if resp.drop:
             # injected connection drop: sever, don't answer
             if req.transport is not None:
                 req.transport.close()
             return web.Response(status=500)
-        except failpoints.FailpointError as e:
-            return web.json_response({"error": str(e)}, status=e.status)
-        except CrcMismatch as e:
-            return web.json_response({"error": str(e)}, status=500)
-        except (EcVolumeError, BackendError) as e:
-            # retryable server-side degradation: an EC read that could
-            # not gather enough shards (remote holders unreachable /
-            # registry not yet synced) or a tiered volume whose remote
-            # tier is down — clean 503, never a traceback
-            if metrics.HAVE_PROMETHEUS:
-                metrics.VOLUME_REQUEST_COUNTER.labels("read", "error").inc()
-            return web.json_response({"error": str(e)}, status=503)
-        headers = {"Etag": f'"{n.etag()}"', "Accept-Ranges": "bytes"}
-        if n.pairs:
-            # stored pairs come back as response headers
-            # (volume_server_handlers_read.go:123-132)
-            try:
-                pair_map = json.loads(n.pairs)
-                if isinstance(pair_map, dict):
-                    headers.update(
-                        {k: str(v) for k, v in pair_map.items()})
-                else:
-                    glog.warning("pairs of %s: not a JSON object",
-                                 req.match_info["fid"])
-            except ValueError:
-                glog.warning("unmarshal pairs of %s: bad json",
-                             req.match_info["fid"])
-        # conditional checks come BEFORE the chunked-manifest branch, as
-        # in the reference (read.go:102-121 precede tryHandleChunkedFile)
-        # — large assembled files are where a 304 saves the most
-        if n.last_modified:
-            headers["Last-Modified"] = time.strftime(
-                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
-            ims = req.headers.get("If-Modified-Since", "")
-            if ims:
-                import calendar
-                try:
-                    # calendar.timegm, NOT mktime: the header is GMT and
-                    # mktime applies the host zone (DST included)
-                    t = calendar.timegm(time.strptime(
-                        ims, "%a, %d %b %Y %H:%M:%S GMT"))
-                    if t >= int(n.last_modified):
-                        return web.Response(status=304, headers=headers)
-                except ValueError:
-                    pass  # unparseable date: serve normally (ref parity)
-        # conditional read (volume_server_handlers_read.go:113-116)
-        if req.headers.get("If-None-Match", "") == f'"{n.etag()}"':
-            return web.Response(status=304, headers=headers)
-        if req.headers.get("ETag-MD5") == "True":
-            # client asked for a content-MD5 etag instead of the CRC one
-            # (volume_server_handlers_read.go:117-121)
-            import hashlib
-            headers["Etag"] = f'"{hashlib.md5(n.data).hexdigest()}"'
-        body = n.data
-        if n.is_chunked_manifest and req.query.get("cm") != "false":
+        if resp.manifest is not None:
             # resolve the manifest into the assembled file
             # (tryHandleChunkedFile, volume_server_handlers_read.go:170)
-            return await self._serve_chunked_file(req, n, headers)
-        if n.is_gzipped:
-            if "gzip" in req.headers.get("Accept-Encoding", ""):
-                headers["Content-Encoding"] = "gzip"
-            else:
-                body = gzip.decompress(body)
-        ct = n.mime.decode() if n.mime else "application/octet-stream"
-        if n.name:
-            # filename-derived mime + Content-Disposition, ?dl=true for
-            # attachment (writeResponseContent, read.go:229-248)
-            fname = n.name.decode(errors="replace")
-            ct = _guess_mime(fname, ct) if not n.mime else ct
-            headers["Content-Disposition"] = _disposition(req, fname)
-        # on-read image resize (volume_server_handlers_read.go:211-227)
-        if ("width" in req.query or "height" in req.query) \
-                and "Content-Encoding" not in headers \
-                and req.method != "HEAD":
-            from ..images import resizing
-            if resizing.resizable(ct):
-                try:
-                    w = int(req.query.get("width", 0) or 0)
-                    h = int(req.query.get("height", 0) or 0)
-                except ValueError:
-                    w = h = 0  # bad params: serve the original (ref parity)
-                mode = req.query.get("mode", "")
-                if w > 0 or h > 0:
-                    body = await self._in_executor(lambda: resizing.resized(ct, body, w, h, mode))
-                    headers.pop("Etag", None)
-        status = 200
-        if "Content-Encoding" not in headers:
-            # serve byte ranges of the (plain) body so chunked readers
-            # don't transfer whole chunks for small ranges
-            from ..util.httprange import RangeError, parse_range
-            try:
-                rng = parse_range(req.headers.get("Range", ""), len(body))
-            except RangeError:
-                return web.Response(
-                    status=416,
-                    headers={"Content-Range": f"bytes */{len(body)}"})
-            if rng is not None:
-                off, ln = rng
-                headers["Content-Range"] = \
-                    f"bytes {off}-{off+ln-1}/{len(body)}"
-                body = body[off:off + ln]
-                status = 206
-        if req.method == "HEAD":
-            return web.Response(status=status, headers=headers,
-                                content_type=ct)
-        # chaos site: error / latency / drop / truncate (the latter
-        # declares the full Content-Length, streams a prefix and severs
-        # the socket — the mid-read death degraded reads must survive)
-        fp = await failpoints.http_respond(
-            req, "volume.read.http", body=body, headers=headers,
-            content_type=ct, status=status)
-        if fp is not None:
-            return fp
-        return web.Response(body=body, headers=headers, content_type=ct,
-                            status=status)
+            return await self._serve_chunked_file(req, resp.manifest,
+                                                  resp.headers)
+        if resp.truncate_to >= 0:
+            # chaos truncate: full Content-Length, partial body, dead
+            # socket — the mid-read death degraded reads must survive
+            sr = web.StreamResponse(status=resp.status, headers={
+                **resp.headers, "Content-Length": str(len(resp.body))})
+            sr.content_type = resp.content_type
+            await sr.prepare(req)
+            await sr.write(resp.body[:resp.truncate_to])
+            if req.transport is not None:
+                req.transport.close()
+            return sr
+        if resp.sendfile is not None:
+            # the aiohttp listener keeps the buffered path; refs are
+            # only minted for the raw listener (wire want_ref)
+            resp.sendfile.close()
+        ct, _, rest = resp.content_type.partition(";")
+        charset = rest.partition("charset=")[2].strip() or None
+        if resp.head or resp.status in (304, 301):
+            if not resp.head:
+                return web.Response(status=resp.status,
+                                    headers=resp.headers)
+            return web.Response(status=resp.status, headers=resp.headers,
+                                content_type=ct, charset=charset)
+        return web.Response(body=resp.body, status=resp.status,
+                            headers=resp.headers,
+                            content_type=ct, charset=charset)
+
+    async def h_get(self, req: web.Request) -> web.StreamResponse:
+        wr = self._wire_request(req)
+        return await self._wire_response(
+            req, await wire.serve_read(self, wr))
+
+    async def h_batch(self, req: web.Request) -> web.StreamResponse:
+        """Pipelined multi-needle GET (`/batch?fids=...` or a POSTed
+        {"fileIds": [...]}) — wire.serve_batch: cache hits inline,
+        cold preads coalesced, sibling fan-out by vid ownership."""
+        body = None
+        if req.method == "POST" and req.can_read_body:
+            body = await req.read()
+        wr = self._wire_request(req, body)
+        return await self._wire_response(
+            req, await wire.serve_batch(self, wr))
 
     def _weed_client(self):
         """Lazily-built client for chunk fetches (lookup-cached)."""
@@ -733,8 +679,9 @@ class VolumeServer:
                          else "application/octet-stream")
         if cm.name:
             if not cm.mime and not n.mime:
-                ct = _guess_mime(cm.name, ct)
-            headers["Content-Disposition"] = _disposition(req, cm.name)
+                ct = wire._guess_mime(cm.name, ct)
+            headers["Content-Disposition"] = wire._disposition(
+                dict(req.query), cm.name)
         try:
             rng = parse_range(req.headers.get("Range", ""), cm.size)
         except RangeError:
@@ -753,7 +700,40 @@ class VolumeServer:
         resp.content_type = ct
         await resp.prepare(req)
         client = self._weed_client()
-        for fid, c_off, c_len, _ in cm.resolve(off, ln):
+        pieces = cm.resolve(off, ln)
+        sizes = {c.fid: c.size for c in cm.chunks}
+        i = 0
+        truncated = False
+        while i < len(pieces) and not truncated:
+            # WHOLE small chunks batch into one multi-needle GET per
+            # window (bounded bytes so large files never fully buffer);
+            # partial/large pieces keep the ranged single-GET path
+            win: list = []
+            win_bytes = 0
+            while i < len(pieces) and len(win) < 32 \
+                    and win_bytes < (4 << 20):
+                fid, c_off, c_len, _ = pieces[i]
+                if c_off == 0 and c_len == sizes.get(fid) \
+                        and c_len <= (1 << 20):
+                    win.append(pieces[i])
+                    win_bytes += c_len
+                    i += 1
+                else:
+                    break
+            if len(win) > 1:
+                got = await client.batch_read([p[0] for p in win])
+                for fid, _, _, _ in win:
+                    piece = got.get(fid)
+                    if piece is None:
+                        truncated = True
+                        break  # stream truncates; client sees short body
+                    await resp.write(piece)
+                continue
+            if win:
+                fid, c_off, c_len, _ = win[0]
+            else:
+                fid, c_off, c_len, _ = pieces[i]
+                i += 1
             try:
                 piece = await client.read(fid, offset=c_off, size=c_len)
             except OperationError:
@@ -762,14 +742,26 @@ class VolumeServer:
         await resp.write_eof()
         return resp
 
-    async def _needle_from_request(self, req: web.Request,
-                                   fid: t.FileId) -> Needle:
-        """ParseUpload analog (needle.go:54): multipart or raw body."""
-        name = b""
-        mime = b""
-        data = b""
+    async def h_post(self, req: web.Request) -> web.StreamResponse:
+        """Write adapter: only TRANSPORT framing is unpacked here
+        (multipart parts vs raw body); needle build, jwt guard, the
+        group-commit store append and replication fan-out are
+        wire.serve_write — the same code the raw listener runs."""
+        # token guard BEFORE any body parsing: an unauthenticated
+        # client must not get to drive multipart/EXIF work (or read
+        # build-time diagnostics) on a jwt-protected server
+        denied = wire.check_jwt(self, self._wire_request(req))
+        if denied is not None:
+            return await self._wire_response(req, denied)
         ctype = req.headers.get("Content-Type", "")
-        if ctype.startswith("multipart/form-data"):
+        n = None
+        body = None
+        if req.headers.get("X-Raw-Needle") == "1":
+            body = await req.read()
+        elif ctype.startswith("multipart/form-data"):
+            name = b""
+            mime = b""
+            data = b""
             reader = await req.multipart()
             async for part in reader:
                 if part.name in ("file", "upload", None) or part.filename:
@@ -780,160 +772,26 @@ class VolumeServer:
                     if pct and pct != "application/octet-stream":
                         mime = pct.encode()
                     break
-        else:
-            data = await req.read()
-            if ctype and ctype != "application/octet-stream":
-                mime = ctype.split(";")[0].encode()
-        if mime in (b"image/jpeg", b"image/jpg") or \
-                (name.lower().endswith((b".jpg", b".jpeg")) and not mime):
-            # bake EXIF rotation into stored bytes (needle.go ParseUpload)
-            from ..images import fix_jpeg_orientation
-            data = fix_jpeg_orientation(data)
-        # Seaweed-* request headers ride along as needle pairs
-        # (needle.go:19,55-60 PairNamePrefix). Matched case-insensitively
-        # and stored canonicalized — Go's net/http canonicalizes header
-        # casing before the prefix check, so 'seaweed-owner' must count
-        pair_map = {k.title(): v for k, v in req.headers.items()
-                    if k.title().startswith("Seaweed-") and v}
-        try:
-            # client-supplied modified time (needle.go:80 "ts")
-            last_modified = int(req.query.get("ts", "") or time.time())
-        except ValueError:
-            last_modified = int(time.time())
-        if not 0 <= last_modified < (1 << 40):
-            # out of the 5-byte on-disk range: a negative/overflowed ts
-            # must not crash serialization or corrupt TTL math
-            last_modified = int(time.time())
-        n = Needle(cookie=fid.cookie, id=fid.key, data=data, name=name,
-                   mime=mime, ttl=t.TTL.parse(req.query.get("ttl", "")),
-                   pairs=(json.dumps(pair_map).encode()
-                          if pair_map else b""),
-                   last_modified=last_modified)
-        n.set_flag(FLAG_HAS_LAST_MODIFIED)
-        if req.query.get("cm") in ("true", "1"):
-            # chunk-manifest needle (needle_parse_multipart.go:86)
-            n.set_flag(FLAG_IS_CHUNK_MANIFEST)
-        return n
-
-    def _check_jwt(self, req: web.Request) -> web.Response | None:
-        """Write-token guard (volume_server_handlers_write.go:41-44).
-        Replica writes must carry the forwarded per-fid token — a bare
-        ?type=replicate does NOT bypass the guard."""
-        if not self.jwt_key:
-            return None
-        from ..security.jwt import (JwtError, check_write_jwt,
-                                    get_jwt_from_request)
-        token = get_jwt_from_request(req.headers, req.query)
-        if not token:
-            return web.json_response({"error": "missing jwt"}, status=401)
-        try:
-            check_write_jwt(self.jwt_key, token, req.match_info["fid"])
-        except JwtError as e:
-            return web.json_response({"error": str(e)}, status=401)
-        return None
-
-    async def h_post(self, req: web.Request) -> web.Response:
-        denied = self._check_jwt(req)
-        if denied is not None:
-            return denied
-        try:
-            fid = self._parse_fid(req.match_info["fid"])
-        except ValueError as e:
-            return web.json_response({"error": str(e)}, status=400)
-        if req.headers.get("X-Raw-Needle") == "1":
-            # replica write: body is the serialized needle record
-            n = Needle.from_bytes(await req.read(), t.CURRENT_VERSION)
-        else:
-            n = await self._needle_from_request(req, fid)
-        from ..stats import metrics
-        try:
-            t0 = time.perf_counter()
-            _, size = await self._in_executor(
-                self.store.write_needle, fid.volume_id, n)
-            if metrics.HAVE_PROMETHEUS:
-                metrics.VOLUME_REQUEST_TIME.labels("write").observe(
-                    time.perf_counter() - t0)
-                metrics.VOLUME_REQUEST_COUNTER.labels("write", "ok").inc()
-        except NotFound:
-            return web.json_response({"error": "volume not found"},
-                                     status=404)
-        except failpoints.FailpointDrop:
-            if req.transport is not None:
-                req.transport.close()
-            return web.Response(status=500)
-        except failpoints.FailpointError as e:
-            return web.json_response({"error": str(e)}, status=e.status)
-        except NeedleError as e:
-            # e.g. >64KB of Seaweed-* pair headers: a client error, not
-            # an unhandled 500 (needle.py:122 pairs-size limit)
-            return web.json_response({"error": str(e)}, status=400)
-        except VolumeError as e:
-            return web.json_response({"error": str(e)}, status=409)
-        # replicate unless this IS a replica write (store_replicate.go:21)
-        if req.query.get("type") != "replicate":
-            v = self.store.volumes.get(fid.volume_id)
-            rp = v.super_block.replica_placement if v else None
-            if rp and rp.copy_count > 1:
-                ok = await self._replicate(
-                    req.match_info["fid"], "POST", n.to_bytes(3),
-                    auth=req.headers.get("Authorization", ""))
-                if not ok:
-                    return web.json_response(
-                        {"error": "replication failed"}, status=500)
-        return web.json_response(
-            {"name": n.name.decode(errors="replace"), "size": size,
-             "eTag": n.etag()}, status=201)
-
-    async def h_delete(self, req: web.Request) -> web.Response:
-        denied = self._check_jwt(req)
-        if denied is not None:
-            return denied
-        try:
-            fid = self._parse_fid(req.match_info["fid"])
-        except ValueError as e:
-            return web.json_response({"error": str(e)}, status=400)
-        n = Needle(cookie=fid.cookie, id=fid.key)
-        is_ec = fid.volume_id in self.store.ec_volumes
-        # a chunk-manifest delete cascades to its chunks — also through
-        # the EC read path, or a manifest in an EC-encoded volume would
-        # orphan every chunk (volume_server_handlers_write.go
-        # DeleteHandler)
-        if req.query.get("type") != "replicate":
             try:
-                existing = await self._in_executor(lambda: self.store.read_needle(
-                        fid.volume_id, fid.key, fid.cookie))
-                if existing.is_chunked_manifest:
-                    from ..util.chunked import ChunkManifest
-                    cm = ChunkManifest.load(existing.data,
-                                            existing.is_gzipped)
-                    await cm.delete_chunks(self._weed_client())
-            except (NotFound, AlreadyDeleted):
-                pass  # nothing stored: plain tombstone below
-            except (ValueError, KeyError, BackendError) as e:
-                # tier outage / corrupt manifest: still tombstone, but
-                # the skipped cascade must be visible — its chunks may
-                # now be orphaned
-                glog.warning("delete %s: manifest cascade skipped: %s",
-                             req.match_info["fid"], e)
-        try:
-            size = await self._in_executor(lambda: self.store.delete_needle(fid.volume_id, n))
-        except NotFound:
-            return web.json_response({"error": "volume not found"},
-                                     status=404)
-        if req.query.get("type") != "replicate":
-            auth = req.headers.get("Authorization", "")
-            if is_ec:
-                # tombstone every shard holder's .ecx
-                # (DeleteEcShardNeedle broadcast, store_ec_delete.go:15-101)
-                await self._ec_delete_broadcast(fid.volume_id,
-                                                req.match_info["fid"], auth)
-            else:
-                v = self.store.volumes.get(fid.volume_id)
-                rp = v.super_block.replica_placement if v else None
-                if rp and rp.copy_count > 1:
-                    await self._replicate(req.match_info["fid"],
-                                          "DELETE", None, auth=auth)
-        return web.json_response({"size": size})
+                fid = self._parse_fid(req.match_info["fid"])
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+            wr = self._wire_request(req)
+            try:
+                n = wire.build_needle(fid, wr, data, name=name,
+                                      mime=mime)
+            except (NeedleError, ValueError) as e:
+                return web.json_response({"error": str(e)}, status=400)
+        else:
+            body = await req.read()
+        wr = self._wire_request(req, body)
+        return await self._wire_response(
+            req, await wire.serve_write(self, wr, n))
+
+    async def h_delete(self, req: web.Request) -> web.StreamResponse:
+        wr = self._wire_request(req)
+        return await self._wire_response(
+            req, await wire.serve_delete(self, wr))
 
     async def h_batch_delete(self, req: web.Request) -> web.Response:
         """One request tombstones many needles locally, with a per-fid
@@ -997,7 +855,6 @@ class VolumeServer:
         # -workers: a batch spans partitions — split by owning worker,
         # delete the local group here, forward each sibling its group,
         # and reassemble results in request order
-        import aiohttp
         groups: dict[int, list] = {}
         for f in fids:
             try:
@@ -1154,7 +1011,6 @@ class VolumeServer:
     async def _sibling_get(self, path: str) -> "list[tuple[int, bytes]]":
         """Fetch `path` from every live sibling worker (token-marked so
         they answer locally instead of re-aggregating)."""
-        import aiohttp
         wc = self.worker_ctx
         out: list[tuple[int, bytes]] = []
 
@@ -1295,6 +1151,9 @@ class VolumeServer:
                 self.store.ec_recover_cache.counters.to_dict()
         if caches:
             out["caches"] = caches
+        gc = self.store.group_commit_stats()
+        if gc["batches"]:
+            out["group_commit"] = gc
         wc = self.worker_ctx
         if wc is not None and not self._is_worker_hop(req):
             # whole-host view: fold in every sibling's partition
@@ -1653,7 +1512,6 @@ class VolumeServer:
         if wc is not None and not self._is_worker_hop(req):
             # split the batch across owning workers; each owner still
             # batches ITS volumes through one kernel launch
-            import aiohttp
             mine = [v for v in vids if wc.owns(v)]
             failed: list[str] = []
 
@@ -1868,9 +1726,34 @@ class VolumeServer:
         return web.json_response({"ok": True, "dat_size": dat_size})
 
     async def h_ec_shard_read(self, req: web.Request) -> web.Response:
-        """VolumeEcShardRead (volume_grpc_erasure_coding.go:254-320)."""
+        """VolumeEcShardRead (volume_grpc_erasure_coding.go:254-320).
+        The batched form `?reads=sid:off:size,...` answers many
+        intervals in one round trip using the shared batch framing —
+        a degraded read's gather costs one request per holder."""
         q = req.query
         vid = int(q["volume"])
+        if "reads" in q:
+            try:
+                reads = [tuple(int(x) for x in part.split(":"))
+                         for part in q["reads"].split(",") if part]
+                if any(len(r) != 3 for r in reads):
+                    raise ValueError
+            except ValueError:
+                return web.json_response(
+                    {"error": "bad reads spec"}, status=400)
+            datas = await self._in_executor(
+                self.store.read_ec_shard_intervals, vid, reads)
+            out = bytearray()
+            for (sid, off, size), data in zip(reads, datas):
+                if data is None:
+                    out += batchframe.encode_record(
+                        {"shard": sid, "status": 404,
+                         "error": "shard not found"})
+                else:
+                    out += batchframe.encode_record(
+                        {"shard": sid, "status": 200}, data)
+            return web.Response(body=bytes(out),
+                                content_type=batchframe.CONTENT_TYPE)
         data = await self._in_executor(lambda: self.store.read_ec_shard_interval(
                 vid, int(q["shard"]), int(q["offset"]), int(q["size"])))
         if data is None:

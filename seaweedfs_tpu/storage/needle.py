@@ -82,6 +82,10 @@ class Needle:
         return self.has(FLAG_IS_CHUNK_MANIFEST)
 
     def etag(self) -> str:
+        if not self.data and self.checksum:
+            # meta-only read (zero-copy ref): derive the same CRC the
+            # buffered path computes, from the stored footer checksum
+            return "%08x" % crc32c.unmasked(self.checksum)
         return "%08x" % (crc32c.crc32c(self.data) & 0xFFFFFFFF)
 
     # ---- serialization ----

@@ -21,11 +21,81 @@ from ..util import failpoints, tracing
 from . import types as t
 from .needle import Needle
 from .super_block import ReplicaPlacement
-from .volume import AlreadyDeleted, NotFound, Volume, VolumeError
+from .volume import (AlreadyDeleted, NotFound, Volume, VolumeError,
+                     _Append)
 
 
 _VOL_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.dat$")
 _EC_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ecx$")
+
+
+class BatchBudgetExceeded(Exception):
+    """Marker for batch-read rows beyond the response byte budget."""
+
+
+class _VolumeAppender:
+    """Group-commit coordinator for one volume: concurrent write_needle
+    callers (executor threads) enqueue; whichever thread finds the
+    commit slot free becomes the LEADER, drains the whole queue and
+    lands it as one vectored append + one flush/fsync
+    (Volume.append_needles). Natural batching: zero added latency for a
+    lone writer, batches form exactly when writers contend. An optional
+    window (-groupcommit.ms) makes the leader linger to deepen batches
+    on bursty open-loop load."""
+
+    __slots__ = ("cv", "queue", "busy", "window",
+                 "batches", "appended", "max_batch")
+
+    def __init__(self, window_s: float = 0.0) -> None:
+        self.cv = threading.Condition()
+        self.queue: list[_Append] = []
+        self.busy = False
+        self.window = window_s
+        self.batches = 0
+        self.appended = 0
+        self.max_batch = 0
+
+    def append(self, v: Volume, n: Needle) -> tuple[int, int, int]:
+        item = _Append(n)
+        with self.cv:
+            self.queue.append(item)
+            while self.busy and not item.done:
+                self.cv.wait()
+            if not item.done:
+                self.busy = True      # this thread leads the next batch
+        if not item.done:
+            if self.window > 0:
+                # deepen the batch: latecomers during the window join
+                time.sleep(self.window)
+            with self.cv:
+                batch = self.queue
+                self.queue = []
+            try:
+                for it in batch:
+                    it.batch = len(batch)
+                v.append_needles(batch)
+                self.batches += 1
+                self.appended += len(batch)
+                if len(batch) > self.max_batch:
+                    self.max_batch = len(batch)
+            except BaseException as e:  # noqa: BLE001 — every waiter
+                # must be released; append_needles only raises on bugs
+                for it in batch:
+                    if not it.done:
+                        it.fail(e)
+            finally:
+                with self.cv:
+                    self.busy = False
+                    self.cv.notify_all()
+        if item.exc is not None:
+            raise item.exc
+        assert item.result is not None
+        # (offset, size, batch-size-this-write-rode-in)
+        return item.result[0], item.result[1], item.batch
+
+    def to_dict(self) -> dict:
+        return {"batches": self.batches, "appended": self.appended,
+                "max_batch": self.max_batch}
 
 
 class Store:
@@ -37,7 +107,9 @@ class Store:
                  compaction_bytes_per_second: int = 0,
                  index_type: str = "auto",
                  partition: "tuple[int, int] | None" = None,
-                 needle_cache_bytes: int = 0):
+                 needle_cache_bytes: int = 0,
+                 group_commit_window: float = 0.0,
+                 fsync: bool = False):
         # needle map kind for every owned volume (-index flag analog)
         self.index_type = index_type
         # hot-needle read cache (-cache.mem flag): parsed needles keyed
@@ -85,8 +157,15 @@ class Store:
         self.deleted_volumes: list[pb.VolumeInformationMessage] = []
         self.new_ec_shards: list[pb.VolumeEcShardInformationMessage] = []
         self.deleted_ec_shards: list[pb.VolumeEcShardInformationMessage] = []
-        # remote shard reader injected by the volume server layer
+        # group-commit append coordination: one appender per volume,
+        # created lazily; window 0 = natural batching (-groupcommit.ms)
+        self.group_commit_window = group_commit_window
+        self.fsync = fsync
+        self._appenders: dict[int, _VolumeAppender] = {}
+        # remote shard readers injected by the volume server layer:
+        # single-interval and batched (one request per holder) forms
         self.fetch_remote_shard = None
+        self.fetch_remote_shard_batch = None
         for d in dirs:
             os.makedirs(d, exist_ok=True)
             self._load_existing(d)
@@ -152,6 +231,8 @@ class Store:
                       large_block=self.ec_large_block,
                       small_block=self.ec_small_block,
                       fetch_remote=self._make_remote_fetcher(vid),
+                      fetch_remote_batch=self._make_remote_batch_fetcher(
+                          vid),
                       recover_cache=self.ec_recover_cache)
         self.ec_volumes[vid] = ev
         return ev
@@ -163,10 +244,18 @@ class Store:
             return self.fetch_remote_shard(vid, shard_id, offset, size)
         return fetch
 
+    def _make_remote_batch_fetcher(self, vid: int):
+        def fetch_batch(reads: "list[tuple[int, int, int]]"):
+            if self.fetch_remote_shard_batch is None:
+                return None
+            return self.fetch_remote_shard_batch(vid, reads)
+        return fetch_batch
+
     # ---- volume lifecycle ----
 
     def _own(self, v: Volume) -> Volume:
         v.compaction_bytes_per_second = self.compaction_bytes_per_second
+        v.fsync = self.fsync
         return v
 
     def add_volume(self, vid: int, collection: str = "",
@@ -258,24 +347,48 @@ class Store:
 
     # ---- data plane ----
 
+    def _appender_for(self, vid: int) -> _VolumeAppender:
+        ap = self._appenders.get(vid)
+        if ap is None:
+            with self._lock:
+                ap = self._appenders.setdefault(
+                    vid, _VolumeAppender(self.group_commit_window))
+        return ap
+
     def write_needle(self, vid: int, n: Needle) -> tuple[int, int]:
-        # chaos site `store.write`: sits below BOTH http paths (aiohttp
-        # handlers and the raw fasthttp protocol), so injected write
-        # faults hit every wire shape. One dict-emptiness check when
-        # disarmed.
+        # chaos site `store.write`: sits below BOTH wire shapes (the
+        # unified wire layer feeds it from the aiohttp and raw
+        # listeners alike), and fires per CALLER — an injected fault
+        # fails only this writer, never the whole group-commit batch.
+        # One dict-emptiness check when disarmed.
         failpoints.sync_fail("store.write")
         with tracing.start("store", "write", vid=vid) as sp:
             v = self.volumes.get(vid)
             if v is None:
                 sp.status = "404"
                 raise NotFound(f"volume {vid} not found")
-            result = v.write_needle(n)
+            # group commit: concurrent writers to this volume coalesce
+            # into one vectored append + one shared flush/fsync
+            item = self._appender_for(vid).append(v, n)
             sp.nbytes = len(n.data)
+            if item[2] > 1:
+                sp.set("gc_batch", item[2])
+            result = (item[0], item[1])
             # AFTER the durable append: dropping first would let a racing
             # reader re-populate the old bytes between drop and write
             if self.needle_cache is not None:
                 self.needle_cache.invalidate(vid, n.id)
             return result
+
+    def group_commit_stats(self) -> dict:
+        """Aggregate group-commit counters across this store's volumes
+        (whole-process view for /status and the wire smoke)."""
+        out = {"batches": 0, "appended": 0, "max_batch": 0}
+        for ap in list(self._appenders.values()):
+            out["batches"] += ap.batches
+            out["appended"] += ap.appended
+            out["max_batch"] = max(out["max_batch"], ap.max_batch)
+        return out
 
     def _cached(self, vid: int, needle_id: int, cookie: int | None,
                 count_miss: bool = True,
@@ -319,50 +432,109 @@ class Store:
                     cookie: int | None = None) -> Needle:
         failpoints.sync_fail("store.read")  # chaos site (see store.write)
         # the store span records WHERE the bytes came from — cache,
-        # pread, or EC gather/reconstruct — the per-request attribution
-        # the degraded-read literature says dominates tail latency
+        # pread, sendfile ref, or EC gather/reconstruct — the
+        # per-request attribution the degraded-read literature says
+        # dominates tail latency
         with tracing.start("store", "read", vid=vid) as sp:
-            n = self._cached(vid, needle_id, cookie)
-            if n is not None:
-                sp.set("source", "cache")
-                sp.nbytes = len(n.data)
-                return n
-            # snapshot the volume's mutation generation BEFORE the disk
-            # read: a write/delete landing between our read and our put
-            # bumps it, and put() then refuses the stale fill
-            nc = self.needle_cache
-            gen = nc.generation(vid) if nc is not None else 0
-            v = self.volumes.get(vid)
-            if v is not None:
-                try:
-                    n = v.read_needle(needle_id, cookie)
-                except OSError:
-                    if vid not in self.volumes:
-                        # the volume was destroyed mid-read (TTL
-                        # reclamation / admin delete): a clean 404, not
-                        # a bad-file-descriptor 500
+            return self._read_inner(vid, needle_id, cookie, sp)
+
+    def read_needle_ex(self, vid: int, needle_id: int,
+                       cookie: int | None = None, ref_min: int = 0):
+        """Read for the unified wire layer: when ``ref_min`` > 0 and
+        the needle is a plain local-volume record at least that big,
+        returns ``(meta_needle, NeedleRef)`` so the caller can
+        zero-copy the body with sendfile; otherwise ``(needle, None)``
+        via the exact buffered path. One failpoint fire, one span,
+        one executor hop either way."""
+        failpoints.sync_fail("store.read")
+        with tracing.start("store", "read", vid=vid) as sp:
+            if ref_min > 0:
+                v = self.volumes.get(vid)
+                if v is not None:
+                    try:
+                        got = v.read_needle_ref(needle_id, cookie,
+                                                ref_min)
+                    except (NotFound, AlreadyDeleted):
                         sp.status = "404"
-                        raise NotFound(f"volume {vid} was removed")
-                    raise
-                if nc is not None:
-                    nc.put(vid, needle_id, n, gen=gen)
-                sp.set("source", "pread")
-                sp.nbytes = len(n.data)
-                return n
-            ev = self.ec_volumes.get(vid)
-            if ev is not None:
-                try:
-                    n = ev.read_needle(needle_id, cookie)
-                except EcNotFound as e:
+                        raise
+                    except OSError:
+                        got = None   # racing unmount etc: buffered path
+                    if got is not None:
+                        n, ref = got
+                        sp.set("source", "sendfile")
+                        sp.nbytes = ref.length
+                        return n, ref
+            return self._read_inner(vid, needle_id, cookie, sp), None
+
+    def read_needles(self, specs: "list[tuple[int, int, int | None]]",
+                     byte_budget: "int | None" = None
+                     ) -> "list[Needle | Exception]":
+        """Batch read: resolve many (vid, needle_id, cookie) specs in
+        ONE executor round trip — the per-request pread coalescing the
+        batch GET endpoint rides. Per-needle failures come back as the
+        exception instead of poisoning the whole batch; once the byte
+        budget is spent, remaining rows come back BatchBudgetExceeded
+        (the server must not buffer an unbounded response for one
+        request — those rows fall back to streamed single GETs)."""
+        out: "list[Needle | Exception]" = []
+        used = 0
+        for vid, needle_id, cookie in specs:
+            if byte_budget is not None and used > byte_budget:
+                out.append(BatchBudgetExceeded(
+                    f"batch byte budget exhausted after {used} bytes"))
+                continue
+            try:
+                n = self.read_needle(vid, needle_id, cookie)
+                used += len(n.data)
+                out.append(n)
+            except Exception as e:  # noqa: BLE001 — per-row verdicts:
+                # the caller maps each to its row's HTTP status
+                out.append(e)
+        return out
+
+    def _read_inner(self, vid: int, needle_id: int,
+                    cookie: int | None, sp) -> Needle:
+        n = self._cached(vid, needle_id, cookie)
+        if n is not None:
+            sp.set("source", "cache")
+            sp.nbytes = len(n.data)
+            return n
+        # snapshot the volume's mutation generation BEFORE the disk
+        # read: a write/delete landing between our read and our put
+        # bumps it, and put() then refuses the stale fill
+        nc = self.needle_cache
+        gen = nc.generation(vid) if nc is not None else 0
+        v = self.volumes.get(vid)
+        if v is not None:
+            try:
+                n = v.read_needle(needle_id, cookie)
+            except OSError:
+                if vid not in self.volumes:
+                    # the volume was destroyed mid-read (TTL
+                    # reclamation / admin delete): a clean 404, not
+                    # a bad-file-descriptor 500
                     sp.status = "404"
-                    raise NotFound(str(e))
-                if nc is not None:
-                    nc.put(vid, needle_id, n, gen=gen)
-                sp.set("source", "ec")
-                sp.nbytes = len(n.data)
-                return n
-            sp.status = "404"
-            raise NotFound(f"volume {vid} not found")
+                    raise NotFound(f"volume {vid} was removed")
+                raise
+            if nc is not None:
+                nc.put(vid, needle_id, n, gen=gen)
+            sp.set("source", "pread")
+            sp.nbytes = len(n.data)
+            return n
+        ev = self.ec_volumes.get(vid)
+        if ev is not None:
+            try:
+                n = ev.read_needle(needle_id, cookie)
+            except EcNotFound as e:
+                sp.status = "404"
+                raise NotFound(str(e))
+            if nc is not None:
+                nc.put(vid, needle_id, n, gen=gen)
+            sp.set("source", "ec")
+            sp.nbytes = len(n.data)
+            return n
+        sp.status = "404"
+        raise NotFound(f"volume {vid} not found")
 
     def delete_needle(self, vid: int, n: Needle) -> int:
         v = self.volumes.get(vid)
@@ -470,6 +642,16 @@ class Store:
         failpoints.sync_fail("store.ec_read")
         data = os.pread(f.fileno(), size, offset)
         return data + b"\x00" * (size - len(data))
+
+    def read_ec_shard_intervals(
+            self, vid: int, reads: "list[tuple[int, int, int]]"
+            ) -> "list[bytes | None]":
+        """Batched shard-interval reads for the wire batch path: one
+        request (and one executor hop) gathers many (shard, offset,
+        size) intervals — the k-fetch fan-out of a degraded read costs
+        one round trip per HOLDER instead of one per interval."""
+        return [self.read_ec_shard_interval(vid, sid, off, size)
+                for sid, off, size in reads]
 
     # ---- heartbeat (store.go:165-219 CollectHeartbeat) ----
 

@@ -31,6 +31,55 @@ class AlreadyDeleted(VolumeError):
     pass
 
 
+class NeedleRef:
+    """Zero-copy handle to one needle's data region inside the .dat
+    file: a private read-only file object (its own offset, its own
+    lifetime — a vacuum swap renaming the .dat keeps the inode alive
+    for in-flight sends) plus the byte window of the DATA field.
+    The owner must close() it, normally after an os.sendfile-style
+    kernel copy of exactly ``length`` bytes at ``offset``."""
+
+    __slots__ = ("file", "offset", "length")
+
+    def __init__(self, file, offset: int, length: int) -> None:
+        self.file = file
+        self.offset = offset
+        self.length = length
+
+    def slice(self, off: int, length: int) -> None:
+        """Narrow to a sub-range of the data window (HTTP Range)."""
+        self.offset += off
+        self.length = length
+
+    def close(self) -> None:
+        try:
+            self.file.close()
+        except OSError:
+            pass
+
+
+class _Append:
+    """One group-commit participant: the needle going in, and the
+    result/exception coming back once the shared batch is durable."""
+
+    __slots__ = ("needle", "result", "exc", "done", "batch")
+
+    def __init__(self, needle: Needle) -> None:
+        self.needle = needle
+        self.result: tuple[int, int] | None = None
+        self.exc: BaseException | None = None
+        self.done = False
+        self.batch = 1
+
+    def finish(self, result: tuple[int, int]) -> None:
+        self.result = result
+        self.done = True
+
+    def fail(self, exc: BaseException) -> None:
+        self.exc = exc
+        self.done = True
+
+
 @dataclass
 class VolumeStat:
     file_count: int
@@ -81,6 +130,10 @@ class Volume:
         # vacuum copy rate limit, bytes/s; 0 = unthrottled
         # (compactionBytePerSecond flag + util/throttler.go)
         self.compaction_bytes_per_second = 0
+        # fsync after every (group-committed) append before acking
+        # writers (-fsync flag); off keeps the historical flush-only
+        # durability point
+        self.fsync = False
         self._lock = threading.RLock()
 
         base = self.file_name()
@@ -289,6 +342,223 @@ class Volume:
                 self.last_modified_ts = modified
             return offset, n.size
 
+    def _validate_append(self, n: Needle):
+        """Shared pre-append checks (under self._lock): TTL inherit +
+        overwrite cookie verification. Returns the existing needle-map
+        entry (or None)."""
+        if n.ttl.count == 0 and self.ttl.count != 0:
+            n.ttl = self.ttl
+        nv = self.nm.get(n.id)
+        if (nv is not None and nv.offset > 0
+                and nv.size != t.TOMBSTONE_FILE_SIZE):
+            existing = self._read_at(nv.offset, nv.size)
+            if existing.cookie != n.cookie:
+                raise VolumeError(
+                    f"mismatching cookie {n.cookie:x} for needle {n.id:x}")
+        return nv
+
+    def append_needles(self, items: "list[_Append]") -> None:
+        """Group-commit append: serialize every queued needle, land the
+        whole batch with ONE vectored pwritev (single pwrite fallback)
+        and ONE flush(+fsync when enabled), then publish the index
+        entries — writers are acked only after the shared durable
+        point. Per-needle validation errors fail only their own slot;
+        an I/O error fails the batch and truncates the torn tail so the
+        on-disk state never acknowledges bytes that didn't land."""
+        with self._lock:
+            if self.read_only:
+                err = VolumeError(f"volume {self.vid} is read-only")
+                for it in items:
+                    it.fail(err)
+                return
+            offset = self.data_size()
+            pos = offset
+            blobs: list[bytes] = []
+            metas: list[tuple[_Append, Needle, int, object]] = []
+            for it in items:
+                n = it.needle
+                try:
+                    nv = self._validate_append(n)
+                    n.append_at_ns = time.time_ns()
+                    blob = n.to_bytes(self.version)
+                except (NeedleError, VolumeError, ValueError) as e:
+                    it.fail(e)
+                    continue
+                metas.append((it, n, pos, nv))
+                blobs.append(blob)
+                pos += len(blob)
+            if not blobs:
+                return
+            try:
+                self._dat.flush()
+                fileno = getattr(self._dat, "fileno", None)
+                if fileno is None:
+                    raise VolumeError(
+                        f"volume {self.vid}: remote .dat is append-less")
+                fd = fileno()
+                self._pwrite_all(fd, blobs, offset)
+                if self.fsync:
+                    os.fsync(fd)
+            except OSError as e:
+                # torn batch: cut the tail back so a crashed/partial
+                # vectored write can never be read as committed records
+                try:
+                    self._dat.truncate(offset)
+                except OSError:
+                    pass
+                for it, _, _, _ in metas:
+                    it.fail(e)
+                return
+            for it, n, at, nv in metas:
+                self.last_append_at_ns = n.append_at_ns
+                if nv is None or nv.offset < at:
+                    self.nm.put(n.id, at, n.size)
+                modified = n.last_modified or n.append_at_ns // 1_000_000_000
+                if modified > self.last_modified_ts:
+                    self.last_modified_ts = modified
+                it.finish((at, n.size))
+
+    @staticmethod
+    def _pwrite_all(fd: int, blobs: list[bytes], offset: int) -> None:
+        """Positioned vectored write of every blob, resilient to short
+        writes and platforms without pwritev."""
+        total = sum(len(b) for b in blobs)
+        written = 0
+        if hasattr(os, "pwritev"):
+            # IOV_MAX-bounded slices; retry the remainder on any short
+            # write by flattening what's left
+            view = memoryview(b"")  # placeholder for the tail path
+            idx = 0
+            while idx < len(blobs) and written < total:
+                group = blobs[idx:idx + 512]
+                want = sum(len(b) for b in group)
+                done = os.pwritev(fd, group, offset + written)
+                written += done
+                if done != want:
+                    break
+                idx += 512
+            if written >= total:
+                return
+            view = memoryview(b"".join(blobs))[written:]
+        else:
+            view = memoryview(b"".join(blobs))
+        while view:
+            done = os.pwrite(fd, view, offset + written)
+            written += done
+            view = view[done:]
+
+    # sendfile eligibility floor is the caller's business; this just
+    # refuses refs when the map entry is too small to be worth one
+    def read_needle_ref(self, needle_id: int, cookie: int | None = None,
+                        min_bytes: int = 0
+                        ) -> "tuple[Needle, NeedleRef] | None":
+        """Zero-copy read: parse header + trailing metadata with two
+        small preads and return the needle (``data`` EMPTY) plus a
+        NeedleRef naming the data region in a PRIVATE file handle, so
+        the body can go disk->socket via os.sendfile without entering
+        Python. Returns None when a ref is not worth it / not possible
+        (remote tier, v1-with-no-meta is fine, too small, torn record)
+        — the caller then takes the buffered path. Raises exactly what
+        read_needle raises for missing/deleted/expired/cookie-mismatch.
+
+        The body CRC is NOT verified here (the bytes never enter
+        userspace); the buffered path keeps CRC-on-read, and scrub
+        (ec.verify) covers cold integrity."""
+        with self._lock:
+            nv = self.nm.get(needle_id)
+            if nv is not None and nv.size == t.TOMBSTONE_FILE_SIZE:
+                raise AlreadyDeleted(f"needle {needle_id:x} deleted")
+            if nv is None or nv.offset == 0:
+                raise NotFound(f"needle {needle_id:x} not found")
+            if self.is_remote or nv.size < max(min_bytes, 32):
+                return None
+            fileno = getattr(self._dat, "fileno", None)
+            if fileno is None:
+                return None
+            fd = fileno()
+            head = os.pread(fd, t.NEEDLE_HEADER_SIZE + 4, nv.offset)
+            if len(head) < t.NEEDLE_HEADER_SIZE + 4:
+                return None
+            n = Needle()
+            n.cookie = int.from_bytes(head[0:4], "big")
+            n.id = int.from_bytes(head[4:12], "big")
+            n.size = int.from_bytes(head[12:16], "big")
+            if n.id != needle_id or n.size != nv.size:
+                return None          # map/record disagree: buffered path
+            if self.version == t.VERSION1:
+                data_len = n.size
+                data_off = nv.offset + t.NEEDLE_HEADER_SIZE
+                meta = b""
+                footer_off = data_off + data_len
+            else:
+                data_len = int.from_bytes(head[16:20], "big")
+                data_off = nv.offset + t.NEEDLE_HEADER_SIZE + 4
+                meta_len = n.size - 4 - data_len
+                if meta_len < 1:
+                    return None      # corrupt body framing
+                meta = os.pread(fd, meta_len, data_off + data_len)
+                if len(meta) < meta_len:
+                    return None
+                footer_off = data_off + data_len + meta_len
+            footer = os.pread(
+                fd, 12 if self.version == t.VERSION3 else 4, footer_off)
+            if len(footer) >= 4:
+                n.checksum = int.from_bytes(footer[0:4], "big")
+            if self.version == t.VERSION3 and len(footer) >= 12:
+                n.append_at_ns = int.from_bytes(footer[4:12], "big")
+            if meta:
+                try:
+                    self._parse_meta(n, meta)
+                except (IndexError, ValueError):
+                    return None
+            # a PRIVATE handle: independent file offset (a dup'd fd
+            # would share the append position with the writer) and an
+            # inode pin across vacuum's .dat swap; opened under the
+            # volume lock so the offsets and the file can't diverge
+            try:
+                f = open(self.file_name() + ".dat", "rb")
+            except OSError:
+                return None
+        if cookie is not None and n.cookie != cookie:
+            f.close()
+            raise NotFound(f"cookie mismatch for needle {needle_id:x}")
+        if n.has_expired():
+            f.close()
+            raise NotFound(f"needle {needle_id:x} expired")
+        return n, NeedleRef(f, data_off, data_len)
+
+    @staticmethod
+    def _parse_meta(n: Needle, meta: bytes) -> None:
+        """Post-data optional fields (flags name mime lm ttl pairs) —
+        the tail of Needle._parse_body, for meta read without data."""
+        from .needle import (FLAG_HAS_MIME, FLAG_HAS_NAME, FLAG_HAS_PAIRS,
+                             FLAG_HAS_TTL, LAST_MODIFIED_BYTES)
+        from .needle import FLAG_HAS_LAST_MODIFIED as _FLM
+        idx = 0
+        n.flags = meta[idx]
+        idx += 1
+        if n.has(FLAG_HAS_NAME):
+            ln = meta[idx]
+            idx += 1
+            n.name = bytes(meta[idx:idx + ln])
+            idx += ln
+        if n.has(FLAG_HAS_MIME):
+            ln = meta[idx]
+            idx += 1
+            n.mime = bytes(meta[idx:idx + ln])
+            idx += ln
+        if n.has(_FLM):
+            n.last_modified = int.from_bytes(
+                meta[idx:idx + LAST_MODIFIED_BYTES], "big")
+            idx += LAST_MODIFIED_BYTES
+        if n.has(FLAG_HAS_TTL):
+            n.ttl = t.TTL.from_bytes(meta[idx:idx + 2])
+            idx += 2
+        if n.has(FLAG_HAS_PAIRS):
+            ln = int.from_bytes(meta[idx:idx + 2], "big")
+            idx += 2
+            n.pairs = bytes(meta[idx:idx + ln])
+
     def delete_needle(self, n: Needle) -> int:
         """Tombstone delete; returns reclaimed byte count
         (volume_read_write.go:115-136)."""
@@ -305,6 +575,13 @@ class Volume:
             self._dat.seek(offset)
             self._dat.write(n.to_bytes(self.version))
             self._dat.flush()
+            if self.fsync:
+                # -fsync must cover tombstones too, or an acked DELETE
+                # could be lost on power failure while a concurrently
+                # acked write in the same window is durable
+                fileno = getattr(self._dat, "fileno", None)
+                if fileno is not None:
+                    os.fsync(fileno())
             self.last_append_at_ns = n.append_at_ns
             self.nm.delete(n.id, offset)
             return size

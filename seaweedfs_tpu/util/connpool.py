@@ -1,0 +1,96 @@
+"""Keep-alive HTTP connection pool for SYNC (executor-thread) fetches.
+
+The EC degraded-read path runs inside executor threads and cannot use
+the server's aiohttp session; it used to open a fresh
+urllib/TCP(+TLS) connection PER shard interval — exactly the k-fetch
+fan-out cost the repair-bandwidth literature (arxiv 1309.0186) says
+dominates recovery. This pool keeps idle `http.client` connections per
+target so a degraded-read burst pays one handshake per holder, not one
+per interval.
+
+Thread-safe; connections are returned to the pool only after a clean
+response, so a torn keep-alive stream is never reused.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+
+from ..security import tls
+from . import glog
+
+
+class PoolError(OSError):
+    pass
+
+
+class SyncHttpPool:
+    def __init__(self, timeout: float = 30.0, per_target: int = 4):
+        self._idle: dict[str, list[http.client.HTTPConnection]] = {}
+        self._lock = threading.Lock()
+        self.timeout = timeout
+        self.per_target = per_target
+
+    def _connect(self, target: str) -> http.client.HTTPConnection:
+        host, _, port = target.rpartition(":")
+        ctx = tls.client_ctx()
+        if ctx is not None:
+            return http.client.HTTPSConnection(
+                host, int(port), timeout=self.timeout, context=ctx)
+        return http.client.HTTPConnection(
+            host, int(port), timeout=self.timeout)
+
+    def _take(self, target: str) -> http.client.HTTPConnection | None:
+        with self._lock:
+            conns = self._idle.get(target)
+            if conns:
+                return conns.pop()
+        return None
+
+    def _give(self, target: str,
+              conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            conns = self._idle.setdefault(target, [])
+            if len(conns) < self.per_target:
+                conns.append(conn)
+                return
+        conn.close()
+
+    def request(self, target: str, path: str,
+                headers: dict | None = None,
+                method: str = "GET") -> tuple[int, bytes]:
+        """One request over a pooled keep-alive connection; a stale
+        idle connection (peer closed it between uses) is retried once
+        on a fresh one. Raises OSError flavors on failure."""
+        for attempt in (0, 1):
+            conn = self._take(target)
+            fresh = conn is None
+            if fresh:
+                conn = self._connect(target)
+            try:
+                conn.request(method, path, headers=headers or {})
+                resp = conn.getresponse()
+                body = resp.read()
+                status = resp.status
+            except (http.client.HTTPException, OSError) as e:
+                conn.close()
+                if fresh or attempt:
+                    raise PoolError(
+                        f"{method} {target}{path}: {e}") from e
+                glog.V(2).infof("connpool %s: stale keep-alive (%s), "
+                                "retrying fresh", target, e)
+                continue
+            if resp.will_close:
+                conn.close()
+            else:
+                self._give(target, conn)
+            return status, body
+        raise PoolError(f"{method} {target}{path}: unreachable")
+
+    def close(self) -> None:
+        with self._lock:
+            for conns in self._idle.values():
+                for c in conns:
+                    c.close()
+            self._idle.clear()

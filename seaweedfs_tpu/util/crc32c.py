@@ -53,3 +53,11 @@ def masked(crc: int) -> int:
 def checksum_value(data: bytes | bytearray | memoryview) -> int:
     """Masked CRC32C as written into a needle footer."""
     return masked(crc32c(data))
+
+
+def unmasked(value: int) -> int:
+    """Inverse of masked(): recover the raw CRC32C from a stored
+    footer checksum (zero-copy reads derive the ETag from the footer
+    without pulling the body into userspace)."""
+    v = (value - 0xA282EAD8) & 0xFFFFFFFF
+    return ((v >> 17) | (v << 15)) & 0xFFFFFFFF

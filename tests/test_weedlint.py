@@ -71,6 +71,48 @@ def test_blocking_io_quiet_in_sync_and_executor_thunks(tmp_path):
     assert found == []
 
 
+def test_blocking_io_covers_vectored_and_zero_copy_syscalls(tmp_path):
+    """The unified-wire data plane's syscalls (group-commit pwritev,
+    raw sendfile, vectored sendmsg) stall the loop exactly like their
+    scalar siblings — flagged in async defs; the sanctioned zero-copy
+    helper (`await loop.sendfile(...)`) never trips the rule."""
+    found = probs(tmp_path, """
+        import os
+        async def h(req):
+            os.pwritev(3, [b"a", b"b"], 0)
+            os.sendfile(4, 3, 0, 100)
+            os.sendmsg(4, [b"hdr"])
+    """, select=["blocking-io"])
+    assert rule_ids(found) == ["blocking-io"] * 3
+    found = probs(tmp_path, """
+        import asyncio
+        async def h(transport, f):
+            # sanctioned zero-copy: awaited loop.sendfile, not os.*
+            await asyncio.get_running_loop().sendfile(
+                transport, f, 0, 100)
+    """, select=["blocking-io"])
+    assert found == []
+
+
+def test_failpoint_site_covers_pwritev_and_sendfile(tmp_path):
+    found = probs(tmp_path, """
+        import os
+        def append_batch(self, blobs, offset):
+            os.pwritev(self._fd, blobs, offset)
+    """, name="seaweedfs_tpu/storage/store.py",
+        select=["failpoint-site"])
+    assert rule_ids(found) == ["failpoint-site"]
+    found = probs(tmp_path, """
+        import os
+        from seaweedfs_tpu.util import failpoints
+        def append_batch(self, blobs, offset):
+            failpoints.sync_fail("store.write")
+            os.pwritev(self._fd, blobs, offset)
+    """, name="seaweedfs_tpu/storage/store.py",
+        select=["failpoint-site"])
+    assert found == []
+
+
 def test_orphan_task_fires_on_dropped_handle(tmp_path):
     found = probs(tmp_path, """
         import asyncio

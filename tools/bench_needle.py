@@ -11,6 +11,10 @@ single-core regressions and multi-core scaling are one command:
     python tools/bench_needle.py zipf 1          # Zipfian hot-read mix,
                                                  # cache on vs off, with
                                                  # needle-cache hit rate
+    python tools/bench_needle.py batch 1         # multi-needle /batch
+                                                 # vs single-GET A/B,
+                                                 # zipf + uniform orders
+                                                 # (round-9 measurement)
     python tools/bench_needle.py trace 2         # after each run, pull
                                                  # /debug/traces (merged
                                                  # across workers) and
@@ -46,6 +50,7 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 BASE_PORT = 21700
 
 _RPS = re.compile(r"^(write|read):\s+([0-9.]+) req/s", re.M)
+_NEEDLES = re.compile(r"needles/s: ([0-9.]+) \(batch=(\d+)")
 
 
 def _wait_assign(master: str, tries: int = 60) -> None:
@@ -84,6 +89,7 @@ def _needle_cache_hit_rate(vol: str) -> "tuple[float, float] | None":
 def bench_one(workers: int, n: int, size: int, conc: int,
               cache_mb: "int | None" = None,
               read_mode: str = "", read_n: int = 0,
+              batch_size: int = 0,
               trace: bool = False) -> dict:
     tmp = tempfile.mkdtemp(prefix=f"swtpu_bn_w{workers}_")
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
@@ -120,6 +126,8 @@ def bench_one(workers: int, n: int, size: int, conc: int,
             bench += ["-readMode", read_mode]
         if read_n:
             bench += ["-readN", str(read_n)]
+        if batch_size:
+            bench += ["-batchSize", str(batch_size)]
         out = subprocess.run(bench, capture_output=True, text=True,
                              env=env, cwd=tmp, timeout=1800).stdout
         rates = dict(_RPS.findall(out))
@@ -127,6 +135,13 @@ def bench_one(workers: int, n: int, size: int, conc: int,
                "write_rps": float(rates.get("write", 0.0)),
                "read_rps": float(rates.get("read", 0.0)),
                "n": n, "size": size, "concurrency": conc}
+        if batch_size:
+            m = _NEEDLES.search(out)
+            if m:
+                # the A/B headline: needles served per second — for
+                # batch rows read_rps counts WIRE requests, not needles
+                row["needles_rps"] = float(m.group(1))
+                row["batch"] = int(m.group(2))
         if read_mode:
             row["read_mode"] = read_mode
             row["reads"] = read_n or n
@@ -156,12 +171,26 @@ def bench_one(workers: int, n: int, size: int, conc: int,
 def main() -> None:
     args = sys.argv[1:]
     zipf = "zipf" in args
+    batch = "batch" in args
     trace = "trace" in args
-    sweep = [int(a) for a in args if a.isdigit()] or ([1] if zipf
-                                                      else [1, 2])
+    sweep = [int(a) for a in args if a.isdigit()] or (
+        [1] if zipf or batch else [1, 2])
     n = int(os.environ.get("SWTPU_BENCH_N", "10000"))
     size = int(os.environ.get("SWTPU_BENCH_SIZE", "1024"))
     conc = int(os.environ.get("SWTPU_BENCH_C", "64"))
+    if batch:
+        # round-9 A/B: multi-needle /batch vs single GET, zipf +
+        # uniform read orders, cache on (the production shape)
+        read_n = int(os.environ.get("SWTPU_BENCH_READN", str(3 * n)))
+        bs = int(os.environ.get("SWTPU_BENCH_BATCH", "32"))
+        for w in sweep:
+            for mode in ("zipf", "shuffle"):
+                for bsz in (bs, 0):
+                    print(json.dumps(bench_one(
+                        w, n, size, conc, cache_mb=32,
+                        read_mode=mode, read_n=read_n,
+                        batch_size=bsz, trace=trace)), flush=True)
+        return
     if zipf:
         # Zipfian hot-read mix, 3 reads per written needle: the cache-on
         # vs cache-off rows are the BENCH_NEEDLE.md comparison

@@ -38,6 +38,12 @@ fi
 echo "== weedlint: tests/ (report-only) =="
 python -m tools.weedlint tests --report-only --no-baseline | tail -n 1
 
+echo "== wire smoke (batch GET + group commit + sendfile, live volume) =="
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/wire_smoke.py; then
+    echo "wire smoke: FAILED (data-plane regression — see output above)"
+    exit 1
+fi
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \

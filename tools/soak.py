@@ -685,7 +685,7 @@ async def scenario_cache_churn(tmp: str) -> int:
         locks: dict = {}
         deleted: set = set()
         stats = {"reads": 0, "stale": 0, "transient": 0,
-                 "overwrites": 0, "deletes": 0}
+                 "overwrites": 0, "deletes": 0, "batched": 0}
         async with WeedClient(
                 master, chunk_cache=TieredChunkCache(8 << 20)) as c:
             await fill(c, payloads, n_files, rng, replication="000")
@@ -736,6 +736,40 @@ async def scenario_cache_churn(tmp: str) -> int:
                                   f"{len(want)}B after overwrite")
                             stats["stale"] += 1
 
+            async def batch_reader() -> None:
+                # the /batch multi-needle wire path must hold the same
+                # read-your-writes bar as single GETs, under the same
+                # armed failpoints; locks taken in sorted order so the
+                # group acquisition can't deadlock against reader()
+                import contextlib
+                while time.time() < stop_at:
+                    group = sorted({pick() for _ in range(4)})
+                    async with contextlib.AsyncExitStack() as held:
+                        for f in group:
+                            await held.enter_async_context(locks[f])
+                        want = {f: payloads.get(f) for f in group}
+                        got = await c.batch_read(group)
+                        for f in group:
+                            g = got.get(f)
+                            if g is None:
+                                # deleted fid: correct; live fid: an
+                                # injected-fault miss — transient
+                                if f not in deleted:
+                                    stats["transient"] += 1
+                                continue
+                            stats["reads"] += 1
+                            stats["batched"] += 1
+                            if want[f] is None:
+                                print(f"  STALE: batch read of "
+                                      f"deleted {f} returned "
+                                      f"{len(g)} bytes")
+                                stats["stale"] += 1
+                            elif g != want[f]:
+                                print(f"  STALE: batch {f} returned "
+                                      f"{len(g)}B != expected "
+                                      f"{len(want[f])}B")
+                                stats["stale"] += 1
+
             async def overwriter() -> None:
                 while time.time() < stop_at:
                     fid = pick()
@@ -770,11 +804,13 @@ async def scenario_cache_churn(tmp: str) -> int:
                         payloads.pop(fid, None)
                         stats["deletes"] += 1
 
-            await asyncio.gather(*[reader() for _ in range(6)],
+            await asyncio.gather(*[reader() for _ in range(4)],
+                                 *[batch_reader() for _ in range(2)],
                                  *[overwriter() for _ in range(2)],
                                  deleter())
             await asyncio.to_thread(_failpoints, vport, "DELETE")
-            print(f"  churn: {stats['reads']} verified reads, "
+            print(f"  churn: {stats['reads']} verified reads "
+                  f"({stats['batched']} via /batch), "
                   f"{stats['overwrites']} overwrites, "
                   f"{stats['deletes']} deletes, "
                   f"{stats['transient']} transient errors, "

@@ -24,7 +24,13 @@ _BLOCKING_ATTRS: dict[str, set[str]] = {
            "fdatasync", "sendfile", "ftruncate", "truncate",
            "listdir", "scandir", "walk", "remove", "unlink",
            "rename", "replace", "rmdir", "makedirs", "mkdir",
-           "stat", "fstat"},
+           "stat", "fstat",
+           # vectored/zero-copy forms (the unified-wire data plane):
+           # group-commit pwritev and raw sendfile block exactly like
+           # their scalar siblings — they belong on the executor or in
+           # sanctioned zero-copy helpers (await loop.sendfile, which
+           # never trips this rule because it is awaited, not os.*)
+           "pwritev", "preadv", "writev", "readv", "sendmsg"},
     "shutil": {"copy", "copyfile", "copyfileobj", "copytree",
                "rmtree", "move"},
     "mmap": {"mmap"},

@@ -139,8 +139,12 @@ class FailpointSiteRule(Rule):
             site = f"{tail_name(f.value)}.{f.attr}"
         elif isinstance(f, ast.Attribute) \
                 and isinstance(f.value, ast.Name) \
-                and f.value.id == "os" and f.attr in ("pread",
-                                                      "pwrite"):
+                and f.value.id == "os" \
+                and f.attr in ("pread", "pwrite", "pwritev", "preadv",
+                               "sendfile"):
+            # the vectored/zero-copy forms are data-plane I/O exactly
+            # like their scalar siblings: the group-commit batch append
+            # and sendfile reads must sit within chaos-site reach
             site = f"os.{f.attr}"
         if not site:
             return
