@@ -164,6 +164,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fsync every group-committed append before "
                         "acking writers (default keeps the historical "
                         "flush-only durability point)")
+    v.add_argument("-scrub.interval", dest="scrub_interval", type=float,
+                   default=0.0,
+                   help="seconds between background EC parity-scrub "
+                        "cycles; 0 disables the paced scrubber "
+                        "(POST /debug/scrub?run=1 still forces one)")
+    v.add_argument("-scrub.mbps", dest="scrub_mbps", type=float,
+                   default=8.0,
+                   help="token-bucket byte budget for scrub reads, "
+                        "MiB/s — sustained scrub I/O never exceeds "
+                        "this; 0 = unpaced")
+    v.add_argument("-scrub.pausems", dest="scrub_pause_ms", type=float,
+                   default=50.0,
+                   help="park the scrubber while any foreground "
+                        "request in the last 2s ran longer than this "
+                        "many ms; 0 never pauses")
 
     f = sub.add_parser("filer", help="start a filer server")
     _add_common(f)
@@ -652,7 +667,10 @@ async def _run_volume(args) -> None:
                       white_list=parse_white_list(args.whiteList),
                       public_url=args.publicUrl,
                       worker_ctx=worker_ctx,
-                      batch_max=args.batch_max)
+                      batch_max=args.batch_max,
+                      scrub_mbps=args.scrub_mbps,
+                      scrub_interval=args.scrub_interval,
+                      scrub_pause_ms=args.scrub_pause_ms)
     await vs.start()
     if worker_ctx is not None:
         print(f"volume worker {worker_ctx.index}/{worker_ctx.total}: "

@@ -22,7 +22,7 @@ from typing import Callable
 from ..storage import types as t
 from ..storage.needle import Needle
 from ..storage.needle_map import SortedFileNeedleMap
-from ..util import glog
+from ..util import failpoints, glog
 from . import gf
 from .locate import (LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE, Interval,
                      locate_data)
@@ -39,6 +39,76 @@ class NotFoundError(EcVolumeError):
     pass
 
 
+class RepairPlan:
+    """Survivor preference order for reconstructing lost shards of one
+    (vid, missing-set): local rows first (free), then remote rows
+    grouped so the batch gather touches as few holders as possible.
+
+    The plan holds the ORDER only — which of the remote rows are
+    actually fetched is decided per interval, after cached survivor
+    bytes are consumed — so one plan serves every offset. Cached per
+    missing-set on the EcVolume and invalidated on shard
+    mount/unmount (and on a holder-map refresh, which can regroup the
+    remote rows)."""
+
+    __slots__ = ("local", "remote")
+
+    def __init__(self, local: list[int], remote: list[int]):
+        self.local = local
+        self.remote = remote
+
+
+def order_holder_groups(groups: dict) -> list[int]:
+    """Remote-row preference order from {holder_key: [sids]}: largest
+    holder groups first (the batch gather costs the fewest round trips
+    for the bytes that must move), unknown-holder rows (None key)
+    last, sids ascending within a group. THE shared ordering — both
+    select_survivors and EcVolume._repair_plan build their remote tail
+    through it, so the spec'd selection and the shipped plan cannot
+    drift."""
+    ordered = sorted(((sorted(g), key) for key, g in groups.items()),
+                     key=lambda t: (t[1] is None, -len(t[0]),
+                                    t[0][0] if t[0] else -1))
+    return [sid for g, _ in ordered for sid in g]
+
+
+def select_survivors(want_sid: int, local, cached=(), remote_groups=(),
+                     k: int = gf.DATA_SHARDS) -> list[int]:
+    """Choose exactly k survivor rows for reconstructing `want_sid`,
+    cheapest bytes first (arxiv 2306.10528's selection step):
+
+      1. local shards — zero bytes moved;
+      2. cached survivor intervals — bytes already moved once, reused;
+      3. remote shards, holder groups largest-first — the batch gather
+         then costs the fewest round trips for the bytes it must move.
+
+    remote_groups: iterable of sid groups, one per holder (a bare
+    iterable of sids counts as one group each). Deterministic; raises
+    EcVolumeError when fewer than k distinct survivors exist."""
+    chosen: list[int] = []
+    seen = {want_sid}
+
+    def take(sids) -> bool:
+        for sid in sids:
+            if sid in seen:
+                continue
+            seen.add(sid)
+            chosen.append(sid)
+            if len(chosen) == k:
+                return True
+        return False
+
+    groups = {i: (sorted(g) if isinstance(g, (list, tuple, set,
+                                              frozenset)) else [g])
+              for i, g in enumerate(remote_groups)}
+    if take(sorted(local)) or take(sorted(cached)) \
+            or take(order_holder_groups(groups)):
+        return chosen
+    raise EcVolumeError(
+        f"cannot plan recovery of shard {want_sid}: only "
+        f"{len(chosen)} survivors available, need {k}")
+
+
 class EcVolume:
     def __init__(self, dirname: str, collection: str, vid: int,
                  version: int = t.CURRENT_VERSION,
@@ -47,7 +117,9 @@ class EcVolume:
                  encoder=None,
                  fetch_remote: Callable[[int, int, int], bytes | None] | None = None,
                  fetch_remote_batch=None,
-                 recover_cache=None):
+                 recover_cache=None,
+                 holder_peek=None,
+                 refresh_holders=None):
         self.dir = dirname
         self.collection = collection
         self.vid = vid
@@ -69,6 +141,16 @@ class EcVolume:
         # | None — one request per remote HOLDER instead of one per
         # shard interval (the recover gather's network fan-out)
         self.fetch_remote_batch = fetch_remote_batch
+        # repair planning hooks (volume server): holder_peek() returns
+        # {sid: holder_key} for NON-local shards from the location
+        # cache without any I/O (grouping remote rows by holder);
+        # refresh_holders() forces one holder-map re-resolve after a
+        # failed batch gather
+        self.holder_peek = holder_peek
+        self.refresh_holders = refresh_holders
+        # per-(missing-set) repair plans; invalidated on shard
+        # mount/unmount and holder-map refresh
+        self._plans: dict[frozenset, RepairPlan] = {}
         base = collection + "_" + str(vid) if collection else str(vid)
         self.base_name = os.path.join(dirname, base)
         self._ecx = SortedFileNeedleMap(self.base_name + ".ecx",
@@ -134,6 +216,7 @@ class EcVolume:
         (readOneEcShardInterval, store_ec.go:178-209)."""
         f = self.shards.get(sid)
         if f is not None:
+            failpoints.sync_fail("ec.shard_read")
             # pread: position-independent, safe under concurrent readers
             data = os.pread(f.fileno(), size, offset)
             if len(data) == size:
@@ -145,15 +228,52 @@ class EcVolume:
                 return data
         return self._recover_interval(sid, offset, size)
 
+    # ---- repair planning (minimal-fetch degraded reads) ----
+
+    def invalidate_plans(self) -> None:
+        """Drop cached repair plans: shard mount/unmount (the missing
+        set moved) or a holder-map refresh (the remote grouping
+        moved). Cheap — plans rebuild lazily on the next recover."""
+        self._plans.clear()
+
+    def _repair_plan(self, want_sid: int) -> RepairPlan:
+        """The cached survivor preference order for the current
+        missing-set (every shard with no local file). One plan serves
+        every lost shard and every offset: `want_sid` is excluded at
+        selection time, and which remote rows actually move is decided
+        per interval after cached bytes are consumed."""
+        local = sorted(self.shards)
+        missing = frozenset(range(gf.TOTAL_SHARDS)) - frozenset(local)
+        plan = self._plans.get(missing)
+        if plan is not None:
+            return plan
+        holders: dict = {}
+        if self.holder_peek is not None:
+            try:
+                holders = self.holder_peek() or {}
+            except Exception as e:  # noqa: BLE001 — planning is an
+                # optimization; a failed peek degrades to sid order
+                glog.V(2).infof("ec plan holder peek vid=%d: %s",
+                                self.vid, e)
+        groups: dict[object, list[int]] = {}
+        for sid in sorted(missing):
+            groups.setdefault(holders.get(sid), []).append(sid)
+        plan = RepairPlan(local, order_holder_groups(groups))
+        self._plans[missing] = plan
+        return plan
+
     def _recover_interval(self, want_sid: int, offset: int, size: int) -> bytes:
-        """Gather the same interval from >=10 other shards and decode
-        (recoverOneRemoteEcShardInterval, store_ec.go:319-373).
+        """Gather k survivor rows of the same interval and decode
+        (recoverOneRemoteEcShardInterval, store_ec.go:319-373) —
+        minimal-fetch: the repair plan orders survivors local-first,
+        then cached, then remote grouped by holder, and exactly the
+        k rows the decode needs are read (arxiv 2306.10528).
 
         Hot intervals of a lost shard are served from the
-        reconstruction cache: repeated degraded reads of the same
-        needle reuse the decoded bytes instead of re-gathering ten
-        shards and re-running the GF(256) transform (the dominant
-        degraded-read cost — arxiv 2306.10528)."""
+        reconstruction cache; remotely fetched SURVIVOR rows are
+        cached under the same keyspace, so recovering a second lost
+        shard of the same stripe re-uses the bytes already moved
+        instead of re-fetching them."""
         from ..util import tracing
         rc = self._recover_cache
         key = (self.vid, want_sid, offset, size)
@@ -173,72 +293,192 @@ class EcVolume:
         # attributable per request, not only in aggregate
         with tracing.start("ec", "recover", vid=self.vid,
                            shard=want_sid) as sp:
-            # local shards first (free), then ONE batched remote gather
-            # for however many more the decode needs — the k-fetch
-            # network fan-out collapses to one request per holder
-            local: dict[int, bytes] = {}
-            want_remote: list[int] = []
-            for sid in range(gf.TOTAL_SHARDS):
+            failpoints.sync_fail("ec.recover.read")
+            plan = self._repair_plan(want_sid)
+            k = gf.DATA_SHARDS
+            got: dict[int, bytes] = {}
+            stale_local: list[int] = []
+            for sid in plan.local:
+                if len(got) >= k:
+                    break
                 if sid == want_sid:
                     continue
                 f = self.shards.get(sid)
-                if f is not None and len(local) < gf.DATA_SHARDS:
-                    raw = os.pread(f.fileno(), size, offset)
-                    local[sid] = raw + b"\x00" * (size - len(raw))
-                elif f is None:
-                    want_remote.append(sid)
-            remote: dict[int, bytes] = {}
-            missing = gf.DATA_SHARDS - len(local)
-            if missing > 0 and want_remote:
-                batch = None
-                if self.fetch_remote_batch is not None:
-                    # only as many intervals as the decode still needs:
-                    # over-asking would move (and pread) extra repair
-                    # bytes on every holder; the per-shard fallback
-                    # below covers holders that failed to serve
-                    batch = self.fetch_remote_batch(
-                        [(sid, offset, size)
-                         for sid in want_remote[:missing]])
-                if batch:
+                if f is None:
+                    # unmounted between planning and this read: the
+                    # shard may now live on a peer — demote it to a
+                    # remote candidate instead of dropping the row
+                    stale_local.append(sid)
+                    continue
+                raw = os.pread(f.fileno(), size, offset)
+                got[sid] = raw + b"\x00" * (size - len(raw))
+            n_local = len(got)
+            # cached survivor intervals: bytes a previous recover of
+            # ANOTHER lost shard already moved — free the second time
+            if rc is not None and len(got) < k:
+                for sid in plan.remote:
+                    if len(got) >= k:
+                        break
+                    if sid == want_sid:
+                        continue
+                    b = rc.get((self.vid, sid, offset, size))
+                    if b is not None and len(b) == size:
+                        got[sid] = b
+            n_cached = len(got) - n_local
+            fetched: dict[int, bytes] = {}
+            want_remote = [sid for sid in plan.remote
+                           if sid != want_sid and sid not in got] \
+                + stale_local
+            refreshed = False
+
+            def gather(cands: list[int], need: int) -> None:
+                if need <= 0 or not cands or \
+                        self.fetch_remote_batch is None:
+                    return
+                # only as many intervals as the decode still needs:
+                # over-asking would move (and pread) extra repair
+                # bytes on every holder
+                batch = self.fetch_remote_batch(
+                    [(sid, offset, size) for sid in cands[:need]])
+                if not batch:
+                    return
+                taken = 0
+                # `need` bounds THIS call's acceptance: the retry
+                # gather after a partially-successful first batch must
+                # still be able to admit its rows (the shared dict
+                # already holds the first batch's)
+                for sid in cands:
+                    data = batch.get(sid)
+                    if data is not None and taken < need:
+                        fetched[sid] = data
+                        taken += 1
+
+            gather(want_remote, k - len(got))
+            if len(got) + len(fetched) < k and want_remote:
+                # the batch came back short: refresh the holder map
+                # ONCE, then retry the remainder as a SECOND batch —
+                # never a per-shard loop against the same stale
+                # holders for every shard in the batch. (The wired
+                # batch fetcher may itself have invalidated the map
+                # already — either way the next resolve sees the
+                # freshest state, so the retry is issued
+                # unconditionally: one batched attempt costs at most
+                # one wasted round trip, strictly cheaper than the
+                # k-shortfall per-shard singles it preempts.)
+                if self.refresh_holders is not None:
+                    try:
+                        self.refresh_holders()
+                    except Exception as e:  # noqa: BLE001 — refresh is
+                        # best-effort; the per-shard fallback still runs
+                        glog.V(1).infof("ec holder refresh vid=%d: %s",
+                                        self.vid, e)
+                    refreshed = True
+                    self.invalidate_plans()
+                gather([sid for sid in want_remote
+                        if sid not in fetched],
+                       k - len(got) - len(fetched))
+                # last resort: per-shard fetch for stragglers, against
+                # the refreshed map
+                if len(got) + len(fetched) < k \
+                        and self.fetch_remote is not None:
                     for sid in want_remote:
-                        data = batch.get(sid)
-                        if data is not None and len(remote) < missing:
-                            remote[sid] = data
-                if len(remote) < missing and self.fetch_remote is not None:
-                    for sid in want_remote:
-                        if sid in remote:
+                        if sid in fetched:
                             continue
-                        if len(remote) >= missing:
+                        if len(got) + len(fetched) >= k:
                             break
                         data = self.fetch_remote(sid, offset, size)
                         if data is not None:
-                            remote[sid] = data
-            merged = {**local, **remote}
+                            fetched[sid] = data
+            got.update(fetched)
             bufs: list[np.ndarray] = []
             rows: list[int] = []
-            for sid in sorted(merged):
-                if len(rows) == gf.DATA_SHARDS:
+            for sid in sorted(got):
+                if len(rows) == k:
                     break
                 rows.append(sid)
-                bufs.append(np.frombuffer(merged[sid], np.uint8))
+                bufs.append(np.frombuffer(got[sid], np.uint8))
             sp.set("shards", list(rows))
-            if len(rows) < gf.DATA_SHARDS:
+            if refreshed:
+                sp.event("holder_refresh")
+            if len(rows) < k:
                 raise EcVolumeError(
                     f"cannot recover shard {want_sid}: only {len(rows)} "
                     f"sources available")
             glog.V(3).infof(
-                "ec recover vid=%d shard=%d off=%d size=%d from %s",
-                self.vid, want_sid, offset, size, rows)
-            coeff = gf.shard_rows([want_sid], rows)
+                "ec recover vid=%d shard=%d off=%d size=%d from %s "
+                "(local=%d cached=%d fetched=%d)",
+                self.vid, want_sid, offset, size, rows,
+                n_local, n_cached, len(fetched))
+            coeff = gf.cached_shard_rows((want_sid,), tuple(rows))
             out = _transform_buffers(self.encoder(size), coeff, bufs)
             data = np.asarray(out[0], np.uint8).tobytes()
             sp.nbytes = len(data)
             if rc is not None:
                 if gen is not None:
                     rc.put_fenced(key, data, gen)
+                    # survivor rows that moved over the network are
+                    # worth keeping too: a follow-up recover of a
+                    # DIFFERENT lost shard of this stripe reuses them
+                    # (same fence — stale survivor bytes must lose to
+                    # a re-encode exactly like decoded ones)
+                    for sid, b in fetched.items():
+                        rc.put_fenced((self.vid, sid, offset, size),
+                                      b, gen)
                 else:
                     rc.put(key, data)
+                    for sid, b in fetched.items():
+                        rc.put((self.vid, sid, offset, size), b)
             return data
+
+    def verify_window(self, offset: int, size: int,
+                      strict: bool = False) -> bool:
+        """Recompute RS(10,4) parity over ONE stripe window and compare
+        against the stored parity rows — the scrub unit, paced
+        window-by-window by ec/scrub.py's token bucket. Reads all 14
+        rows (local preferred; missing rows come via remote fetch).
+
+        strict=True (the scrubber) refuses to substitute a
+        RECONSTRUCTED row when a holder stops serving mid-window:
+        parity recomputed from rows derived from the other rows
+        matches trivially, so a 'clean' verdict would claim evidence
+        about bytes that were never examined — the unreachable shard
+        raises EcVolumeError instead and the volume's pass is reported
+        as an error, not a clean scan. strict=False keeps the
+        verify_parity semantics (recovered rows allowed, flagged
+        volume-wide via used_recovered_rows).
+
+        The `scrub.read` failpoint (action `flip`) corrupts rows here
+        — the injection point the scrub soak uses to prove planted
+        corruption is detected while foreground reads stay clean."""
+        rows = []
+        for sid in range(gf.TOTAL_SHARDS):
+            if strict and sid not in self.shards:
+                data = (self.fetch_remote(sid, offset, size)
+                        if self.fetch_remote is not None else None)
+                if data is None:
+                    raise EcVolumeError(
+                        f"shard {sid} unreachable mid-scrub: window "
+                        f"{offset} has no evidence for it")
+            else:
+                data = self._read_shard_interval(sid, offset, size)
+            if failpoints.armed():
+                data = failpoints.corrupt("scrub.read", data)
+                if len(data) != size:  # truncate armed: keep row shape
+                    data = data[:size] + b"\x00" * (size - len(data))
+            rows.append(np.frombuffer(data, np.uint8))
+        enc = self.encoder(size)
+        from .encoder_cpu import CpuEncoder
+        if isinstance(enc, CpuEncoder):
+            return enc.verify(rows)
+        return bool(enc.verify(np.stack(rows)))
+
+    def missing_shards(self) -> list[int]:
+        """Shards neither local nor remotely fetchable (they verify via
+        rebuild, not scrub)."""
+        return [sid for sid in range(gf.TOTAL_SHARDS)
+                if sid not in self.shards
+                and (self.fetch_remote is None
+                     or self.fetch_remote(sid, 0, 1) is None)]
 
     def verify_parity(self, window_size: int = 4 << 20) -> dict:
         """Scrub: recompute RS(10,4) parity over every stripe window and
@@ -251,33 +491,18 @@ class EcVolume:
         here); windows containing RECOVERED rows can't add evidence and
         are flagged. Returns {"windows", "bad_windows": [offsets],
         "missing_shards": [sids], "shard_size"}."""
-        import numpy as np
-
         ssize = self.shard_size
-        missing = [sid for sid in range(gf.TOTAL_SHARDS)
-                   if sid not in self.shards
-                   and (self.fetch_remote is None
-                        or self.fetch_remote(sid, 0, 1) is None)]
+        missing = self.missing_shards()
         bad: list[int] = []
-        recovered = len(missing) > 0
         windows = 0
         for off in range(0, ssize, window_size):
             w = min(window_size, ssize - off)
-            rows = [np.frombuffer(
-                self._read_shard_interval(sid, off, w), np.uint8)
-                for sid in range(gf.TOTAL_SHARDS)]
             windows += 1
-            enc = self.encoder(w)
-            from .encoder_cpu import CpuEncoder
-            if isinstance(enc, CpuEncoder):
-                ok = enc.verify(rows)
-            else:
-                ok = enc.verify(np.stack(rows))
-            if not ok:
+            if not self.verify_window(off, w):
                 bad.append(off)
         return {"windows": windows, "bad_windows": bad,
                 "missing_shards": missing, "shard_size": ssize,
-                "used_recovered_rows": recovered}
+                "used_recovered_rows": len(missing) > 0}
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
         """Locate via .ecx, gather stripe intervals, parse + CRC-check

@@ -395,25 +395,17 @@ def present_shards(base_name: str) -> list[int]:
             if os.path.exists(base_name + to_ext(i))]
 
 
-def rebuild_ec_files(base_name: str, encoder=None,
-                     buffer_size: int = 8 * 1024 * 1024) -> list[int]:
-    """Regenerate missing shard files from >=10 present ones
-    (RebuildEcFiles -> rebuildEcFiles, ec_encoder.go:227-281).
-    Returns the rebuilt shard ids."""
-    encoder = encoder or get_encoder()
-    have = present_shards(base_name)
-    missing = [i for i in range(gf.TOTAL_SHARDS) if i not in have]
-    if not missing:
-        return []
-    if len(have) < gf.DATA_SHARDS:
-        raise ValueError(
-            f"unrepairable: only {len(have)} shards present, "
-            f"need {gf.DATA_SHARDS}")
-    use = have[:gf.DATA_SHARDS]
-    coeff = gf.shard_rows(missing, use)
+def _rebuild_rows(base_name: str, encoder, targets: list[int],
+                  use: list[int], buffer_size: int,
+                  stats: dict | None) -> None:
+    """Regenerate the `targets` shard files from the k `use` shards in
+    ONE coefficient-matrix multiply per window: every window reads the
+    k survivor rows once and one encoder launch emits ALL target rows
+    (len(targets) x k coefficients) — the batched-rebuild unit."""
+    coeff = gf.cached_shard_rows(tuple(targets), tuple(use))
     shard_size = os.path.getsize(base_name + to_ext(use[0]))
     ins = [open(base_name + to_ext(i), "rb") for i in use]
-    outs = [open(base_name + to_ext(i), "wb") for i in missing]
+    outs = [open(base_name + to_ext(i), "wb") for i in targets]
 
     def batches():
         pos = 0
@@ -430,11 +422,19 @@ def rebuild_ec_files(base_name: str, encoder=None,
             pos += take
 
     def launch(buffers):
+        if stats is not None:
+            stats["bytes_read"] = stats.get("bytes_read", 0) + \
+                sum(len(b) for b in buffers)
+            stats["launches"] = stats.get("launches", 0) + 1
         return buffers, _transform_buffers_async(encoder, coeff, buffers)
 
     def write_result(buffers, thunk):
         for o, buf in zip(outs, thunk()):
-            o.write(np.asarray(buf, np.uint8).tobytes())
+            out = np.asarray(buf, np.uint8).tobytes()
+            if stats is not None:
+                stats["bytes_rebuilt"] = \
+                    stats.get("bytes_rebuilt", 0) + len(out)
+            o.write(out)
 
     try:
         _run_overlapped(batches(), launch, write_result,
@@ -444,6 +444,49 @@ def rebuild_ec_files(base_name: str, encoder=None,
             f.close()
         for o in outs:
             o.close()
+
+
+def rebuild_ec_files(base_name: str, encoder=None,
+                     buffer_size: int = 8 * 1024 * 1024,
+                     sequential: bool = False,
+                     stats: dict | None = None) -> list[int]:
+    """Regenerate missing shard files from >=10 present ones
+    (RebuildEcFiles -> rebuildEcFiles, ec_encoder.go:227-281).
+    Returns the rebuilt shard ids.
+
+    Default is the batched whole-volume rebuild: all missing shards
+    of the volume come out of a single coefficient-matrix multiply
+    per window — the survivors are read ONCE and one encoder launch
+    per window emits every lost row. `sequential=True` keeps the
+    per-shard shape (one full pass of survivor reads + one launch
+    stream PER lost shard) as the baseline tools/bench_ec.py measures
+    the batching win against; `stats` (optional dict) accumulates
+    bytes_read / bytes_rebuilt / launches / seconds for that
+    repair-bandwidth accounting."""
+    import time as _time
+
+    encoder = encoder or get_encoder()
+    have = present_shards(base_name)
+    missing = [i for i in range(gf.TOTAL_SHARDS) if i not in have]
+    if not missing:
+        return []
+    if len(have) < gf.DATA_SHARDS:
+        raise ValueError(
+            f"unrepairable: only {len(have)} shards present, "
+            f"need {gf.DATA_SHARDS}")
+    use = have[:gf.DATA_SHARDS]
+    t0 = _time.perf_counter()
+    if sequential:
+        for target in missing:
+            _rebuild_rows(base_name, encoder, [target], use,
+                          buffer_size, stats)
+    else:
+        _rebuild_rows(base_name, encoder, missing, use,
+                      buffer_size, stats)
+    if stats is not None:
+        stats["seconds"] = stats.get("seconds", 0.0) + \
+            (_time.perf_counter() - t0)
+        stats["rebuilt"] = missing
     return missing
 
 

@@ -1,0 +1,345 @@
+"""Paced background EC parity scrubber.
+
+The silent-corruption detector the reference lacks: its integrity
+checking stops at per-needle CRCs *on read* (needle/crc.go), so a
+flipped bit in a cold shard is discovered only when a degraded read
+finally needs that row — mid-recovery, when redundancy is already
+spent. This scrubber walks every mounted EC volume window-by-window
+through ``EcVolume.verify_window`` (the same GF(256) transform the
+encoder uses) and reports corrupt windows BEFORE they cost data.
+
+Three disciplines keep it invisible to the foreground data plane:
+
+* **token-bucket byte budget** (``-scrub.mbps``): every window's
+  14 shard-row reads are paid for before they happen, so sustained
+  scrub I/O can never exceed the operator's budget;
+* **pause-on-foreground-latency** (``-scrub.pausems``): the unified
+  wire layer feeds every served request's duration into
+  ``foreground`` (the exact feed the
+  ``SeaweedFS_volumeServer_request_seconds`` histogram observes);
+  when recent foreground latency crosses the threshold the scrubber
+  parks until the data plane has been healthy for a full window —
+  a loaded or struggling server is never scrubbed harder;
+* **executor isolation**: the reads + parity recompute run off the
+  event loop, so a scrub window never stalls in-flight requests.
+
+Observability: ``SeaweedFS_scrub_*`` metrics, a ``scrub`` trace span
+per volume pass, and ``/debug/scrub`` status (+ ``POST ?run=1`` to
+force a cycle — how the soak drives it deterministically). The
+``scrub.read`` failpoint (action ``flip``) plants corruption the
+scrubber must find; see tools/soak.py's ``scrub`` scenario.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+
+from ..util import glog, tracing
+from . import gf
+
+# how long the scrubber sleeps while parked behind hot foreground
+# traffic before re-checking
+_PAUSE_SLEEP_S = 0.25
+
+
+class ForegroundLoad:
+    """Recent-request latency window fed by wire.observe(), answering
+    one question: has any foreground request in the last `window_s`
+    been slower than `pause_ms`?
+
+    Aggregated into per-second (count, max-duration) buckets, NOT a
+    per-request ring: a request-count-bounded ring evicts its evidence
+    fastest exactly when the server is busiest — at 500 req/s a 512-
+    entry ring covers ~1 s and a 2 s-old slow outlier is already gone.
+    One bucket per wall second covers the window regardless of rate.
+    note() runs only on the event-loop thread (wire.observe inside
+    async handlers); the scrubber reads on the same loop."""
+
+    __slots__ = ("_buckets",)
+
+    # bucket deque length bounds the largest usable window_s
+    MAX_WINDOW_S = 32
+
+    def __init__(self):
+        self._buckets: collections.deque = collections.deque(
+            maxlen=self.MAX_WINDOW_S)   # [sec, count, max_dur_s]
+
+    def clear(self) -> None:
+        self._buckets.clear()
+
+    def note(self, dur_s: float) -> None:
+        sec = int(time.monotonic())
+        b = self._buckets[-1] if self._buckets else None
+        if b is not None and b[0] == sec:
+            b[1] += 1
+            if dur_s > b[2]:
+                b[2] = dur_s
+        else:
+            self._buckets.append([sec, 1, dur_s])
+
+    def snapshot(self, window_s: float) -> tuple[int, float]:
+        """(request count, max duration ms) over the last window_s."""
+        # whole-second buckets: include any bucket that overlaps the
+        # window (err on the pause side, never evict evidence early)
+        cutoff = int(time.monotonic() - window_s)
+        count, worst = 0, 0.0
+        for sec, n, mx in reversed(self._buckets):
+            if sec < cutoff:
+                break
+            count += n
+            if mx > worst:
+                worst = mx
+        return count, worst * 1000.0
+
+    def hot(self, pause_ms: float, window_s: float) -> bool:
+        if pause_ms <= 0:
+            return False
+        _, worst_ms = self.snapshot(min(window_s, self.MAX_WINDOW_S))
+        return worst_ms >= pause_ms
+
+
+# module-level singleton: server/wire.py notes every served request
+# here (one deque append on the hot path), the scrubber consults it
+foreground = ForegroundLoad()
+
+
+class TokenBucket:
+    """Byte-budget pacing: consume(n) debits n bytes, sleeping until
+    the refill (rate bytes/s, burst-capped) covers them. rate <= 0
+    disables pacing. Injectable clock/sleep for deterministic tests."""
+
+    def __init__(self, rate_bytes_s: float, burst_bytes: float | None = None,
+                 now=time.monotonic, sleep=asyncio.sleep):
+        self.rate = rate_bytes_s
+        self.burst = burst_bytes if burst_bytes is not None \
+            else max(rate_bytes_s, 1.0)
+        self._now = now
+        self._sleep = sleep
+        self._tokens = self.burst
+        self._last = now()
+
+    def _refill(self) -> None:
+        now = self._now()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    async def consume(self, n: int) -> float:
+        """Debit n bytes; returns seconds slept."""
+        if self.rate <= 0:
+            return 0.0
+        self._refill()
+        slept = 0.0
+        # oversized requests (a window bigger than the burst) go
+        # negative and simply earn back over time — a single huge
+        # window must not deadlock the bucket
+        if self._tokens < n:
+            wait = (n - self._tokens) / self.rate
+            await self._sleep(wait)
+            slept = wait
+            self._refill()
+        self._tokens -= n
+        return slept
+
+
+class Scrubber:
+    """Continuous paced parity scrub over a Store's mounted EC volumes.
+
+    One instance per volume server (per -workers worker: each scrubs
+    its own partition). `run()` is the long-lived background task —
+    its handle is retained by the server and cancelled on stop (the
+    weedlint orphan-task discipline for paced background loops);
+    `run_cycle()` is one full pass, also callable via
+    POST /debug/scrub?run=1."""
+
+    # corruption reports kept for /debug/scrub (the full stream also
+    # goes to glog.error and the corruptions counter)
+    MAX_REPORTS = 64
+
+    def __init__(self, store, mbps: float = 8.0,
+                 interval_s: float = 300.0,
+                 window_bytes: int = 4 << 20,
+                 pause_ms: float = 50.0,
+                 pause_window_s: float = 2.0,
+                 load: ForegroundLoad | None = None):
+        self.store = store
+        self.mbps = mbps
+        self.interval_s = interval_s
+        self.window_bytes = window_bytes
+        self.pause_ms = pause_ms
+        self.pause_window_s = pause_window_s
+        self.bucket = TokenBucket(mbps * (1 << 20))
+        self.load = load if load is not None else foreground
+        self.state = "idle"
+        self.current: dict | None = None
+        self.cycles = 0
+        self.windows = 0
+        self.corrupt_windows = 0
+        self.bytes_scanned = 0
+        self.pauses = 0          # pause EVENTS (not poll iterations)
+        self.paused_s = 0.0      # total time parked behind foreground
+        self.paced_sleep_s = 0.0
+        self.started_at = time.time()
+        self.corruptions: collections.deque = collections.deque(
+            maxlen=self.MAX_REPORTS)
+        self.last_cycle: dict | None = None
+        self._cycle_lock = asyncio.Lock()
+
+    # ---- metrics ----
+
+    def _count(self, name: str, n: float = 1, label: str | None = None
+               ) -> None:
+        from ..stats import metrics
+        if not metrics.HAVE_PROMETHEUS:
+            return
+        c = getattr(metrics, name)
+        (c.labels(label) if label is not None else c).inc(n)
+
+    # ---- the long-lived paced loop ----
+
+    async def run(self) -> None:
+        # first pass starts after ONE pacing interval, not at boot:
+        # a restarting fleet must not synchronize a scrub stampede
+        # with its own recovery traffic
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                await self.run_cycle()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — the scrubber must
+                # outlive any single cycle's failure shape, visibly
+                glog.warning("scrub cycle failed: %s: %s",
+                             type(e).__name__, e)
+
+    async def run_cycle(self) -> dict:
+        """One full pass over every mounted EC volume. Serialized:
+        a manual POST ?run=1 racing the background loop must not
+        double-scan (and double-charge the budget)."""
+        async with self._cycle_lock:
+            t0 = time.monotonic()
+            report = {"volumes": 0, "windows": 0, "corrupt": 0,
+                      "bytes": 0, "skipped": [], "errors": []}
+            for vid in sorted(self.store.ec_volumes):
+                ev = self.store.ec_volumes.get(vid)
+                if ev is None:
+                    continue  # unmounted while we scanned
+                try:
+                    await self._scrub_volume(vid, ev, report)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — one volume's
+                    # failure (unmount race, dead holder) must not end
+                    # the pass over the others
+                    glog.warning("scrub vid=%d: %s: %s", vid,
+                                 type(e).__name__, e)
+                    report["errors"].append(
+                        {"volume": vid, "error": str(e)})
+            self.cycles += 1
+            self._count("SCRUB_CYCLES")
+            report["seconds"] = round(time.monotonic() - t0, 3)
+            self.last_cycle = report
+            self.state = "idle"
+            self.current = None
+            return report
+
+    async def _scrub_volume(self, vid: int, ev, report: dict) -> None:
+        if 0 not in ev.shards:
+            # scrub ownership, decided FIRST because it is free (no
+            # I/O): with shards spread across holders, every holder
+            # scrubbing the full 14-row stripe would move the same
+            # window bytes over the network once PER HOLDER per cycle
+            # — and even the missing-shards probe below costs ~13
+            # remote round trips per volume. Exactly one server scrubs
+            # a volume: the holder of shard 0 (the lowest shard; a
+            # volume whose shard 0 is LOST outright is skipped
+            # everywhere — its stripe can't fully verify anyway).
+            report["skipped"].append(
+                {"volume": vid, "reason": "not-owner"})
+            return
+        ssize = await tracing.run_in_executor(lambda: ev.shard_size)
+        missing = await tracing.run_in_executor(ev.missing_shards)
+        if missing:
+            # unreachable rows make the parity check inconclusive —
+            # those shards verify via rebuild, not scrub
+            report["skipped"].append(
+                {"volume": vid, "missing_shards": missing})
+            return
+        report["volumes"] += 1
+        with tracing.start_root("scrub", "volume", vid=vid) as sp:
+            off = 0
+            while off < ssize:
+                w = min(self.window_bytes, ssize - off)
+                nbytes = w * gf.TOTAL_SHARDS
+                self.state = "scrubbing"
+                self.current = {"volume": vid, "offset": off,
+                                "shard_size": ssize}
+                # pay for the window BEFORE reading it
+                self.paced_sleep_s += await self.bucket.consume(nbytes)
+                if self.load.hot(self.pause_ms, self.pause_window_s):
+                    # one pause EVENT (however long the park lasts);
+                    # paused_s carries the duration
+                    self.state = "paused"
+                    self.pauses += 1
+                    self._count("SCRUB_PAUSES")
+                    while self.load.hot(self.pause_ms,
+                                        self.pause_window_s):
+                        self.paused_s += _PAUSE_SLEEP_S
+                        await asyncio.sleep(_PAUSE_SLEEP_S)
+                self.state = "scrubbing"
+                if self.store.ec_volumes.get(vid) is not ev:
+                    sp.event("unmounted_midscrub")
+                    return  # unmounted/remounted under us: stop here
+                # strict: a row that would need RECONSTRUCTION mid-
+                # window (holder died since the cycle's missing-shards
+                # probe) raises instead of trivially verifying itself
+                # — the volume lands in the cycle's errors, never in
+                # its clean windows
+                ok = await tracing.run_in_executor(
+                    ev.verify_window, off, w, True)
+                self.windows += 1
+                self.bytes_scanned += nbytes
+                report["windows"] += 1
+                report["bytes"] += nbytes
+                self._count("SCRUB_BYTES", nbytes)
+                self._count("SCRUB_WINDOWS", 1,
+                            "clean" if ok else "corrupt")
+                if not ok:
+                    self.corrupt_windows += 1
+                    report["corrupt"] += 1
+                    self._count("SCRUB_CORRUPTIONS")
+                    rec = {"volume": vid, "offset": off, "size": w,
+                           "wall": time.time()}
+                    self.corruptions.append(rec)
+                    sp.event("corrupt_window", offset=off, size=w)
+                    glog.error(
+                        "scrub: CORRUPT ec window vid=%d off=%d "
+                        "size=%d — stored parity disagrees with "
+                        "recomputed RS(10,4)", vid, off, w)
+                off += w
+            sp.nbytes = report["bytes"]
+
+    # ---- /debug/scrub ----
+
+    def status(self) -> dict:
+        return {
+            "enabled": self.interval_s > 0,
+            "state": self.state,
+            "current": self.current,
+            "budget_mbps": self.mbps,
+            "interval_s": self.interval_s,
+            "window_bytes": self.window_bytes,
+            "pause_ms": self.pause_ms,
+            "cycles": self.cycles,
+            "windows": self.windows,
+            "corrupt_windows": self.corrupt_windows,
+            "bytes_scanned": self.bytes_scanned,
+            "pauses": self.pauses,
+            "paused_s": round(self.paused_s, 3),
+            "paced_sleep_s": round(self.paced_sleep_s, 3),
+            "uptime_s": round(time.time() - self.started_at, 1),
+            "corruptions": list(self.corruptions),
+            "last_cycle": self.last_cycle,
+        }

@@ -81,14 +81,23 @@ class EcLocationCache:
         e.locations = None
         return None
 
-    def invalidate(self, vid: int) -> None:
+    def peek(self, vid: int) -> dict | None:
+        """Whatever locations are cached RIGHT NOW, with no lookup and
+        no staleness bookkeeping — the repair planner's holder-grouping
+        input (a plan built from slightly stale holders still fetches
+        correct bytes; the fetchers re-resolve on failure)."""
+        e = self._entries.get(vid)
+        return e.locations if e is not None else None
+
+    def invalidate(self, vid: int) -> bool:
         """A shard fetch against cached locations failed: the topology
         has moved under us. The FIRST invalidation in a FRESH_S window
         forces an immediate re-lookup (a degraded read right after a
         shard move must not stay stuck on dead holders); further
         invalidations inside the window fall back to the normal
         suppression, so an every-holder-down storm still costs at most
-        one master lookup per FRESH_S."""
+        one master lookup per FRESH_S. Returns whether this call
+        forced the immediate re-lookup (informational)."""
         e = self._entry(vid)
         now = self._now()
         with e.lock:
@@ -97,3 +106,5 @@ class EcLocationCache:
             if now - e.last_forced >= self.FRESH_S:
                 e.attempted_at = -1e9
                 e.last_forced = now
+                return True
+            return False

@@ -67,7 +67,10 @@ class VolumeServer:
                  public_url: str = "",
                  worker_ctx=None,
                  batch_max: int = wire.BATCH_MAX_DEFAULT,
-                 sendfile_min: int = wire.SENDFILE_MIN):
+                 sendfile_min: int = wire.SENDFILE_MIN,
+                 scrub_mbps: float = 8.0,
+                 scrub_interval: float = 0.0,
+                 scrub_pause_ms: float = 50.0):
         # -workers N process-per-core mode (server/workers.py): this
         # server is worker `ctx.index` of `ctx.total`, sharing the
         # public port via SO_REUSEPORT and owning vids % total == index
@@ -113,6 +116,13 @@ class VolumeServer:
         # fetches — one handshake per holder, not one per interval
         from ..util.connpool import SyncHttpPool
         self._sync_pool = SyncHttpPool(timeout=30.0)
+        # paced background parity scrubber (-scrub.interval > 0 starts
+        # the loop; the object always exists so POST /debug/scrub?run=1
+        # can force a cycle even when the loop is off)
+        from ..ec.scrub import Scrubber
+        self.scrubber = Scrubber(store, mbps=scrub_mbps,
+                                 interval_s=scrub_interval,
+                                 pause_ms=scrub_pause_ms)
         self.app = self._build_app()
         store.fetch_remote_shard = None  # wired after start (needs loop)
 
@@ -269,6 +279,7 @@ class VolumeServer:
         app.router.add_post("/admin/tier/upload", self.h_tier_upload)
         app.router.add_post("/admin/tier/download", self.h_tier_download)
         app.router.add_route("*", "/debug/failpoints", self.h_failpoints)
+        app.router.add_route("*", "/debug/scrub", self.h_scrub)
         app.router.add_get("/debug/breakers", self.h_breakers)
         app.router.add_get("/debug/traces", self.h_traces)
         app.router.add_get("/debug/requests", self.h_requests)
@@ -349,7 +360,17 @@ class VolumeServer:
         self.store.fetch_remote_shard = self._sync_fetch_remote_shard
         self.store.fetch_remote_shard_batch = \
             self._sync_fetch_remote_shard_batch
+        # repair-planning hooks: holder grouping from the location
+        # cache (no I/O) and the refresh-once-on-failed-batch-gather
+        # re-resolve (ec_volume._recover_interval)
+        self.store.ec_holder_peek = self._peek_ec_holders
+        self.store.ec_refresh_holders = self._ec_locations.invalidate
         self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+        if self.scrubber.interval_s > 0:
+            # long-lived paced loop: handle retained here and cancelled
+            # in stop() (the orphan-task discipline for background
+            # scrub-pattern tasks)
+            self._tasks.append(asyncio.create_task(self.scrubber.run()))
 
     async def stop(self) -> None:
         for task in self._tasks:
@@ -394,6 +415,22 @@ class VolumeServer:
         if status != 200:
             raise OSError(f"ec_lookup {vid}: http {status}")
         return _json.loads(body)["shards"]
+
+    def _peek_ec_holders(self, vid: int) -> dict | None:
+        """{sid: first non-self holder} from the location cache with NO
+        lookup I/O — the repair planner's grouping input. None when the
+        cache has nothing yet (the plan degrades to sid order and the
+        actual fetch resolves holders as before)."""
+        locs = self._ec_locations.peek(vid)
+        if locs is None:
+            return None
+        out: dict[int, str] = {}
+        for sid_s, urls in locs.items():
+            for u in urls:
+                if u != self.url:
+                    out[int(sid_s)] = u
+                    break
+        return out
 
     def _sync_fetch_remote_shard(self, vid: int, shard_id: int,
                                  offset: int, size: int) -> bytes | None:
@@ -1124,6 +1161,63 @@ class VolumeServer:
                     rows.append(r)
             rows.sort(key=lambda r: -r.get("age_ms", 0))
             payload = {"inflight": len(rows), "requests": rows}
+        return web.json_response(payload)
+
+    async def h_scrub(self, req: web.Request) -> web.Response:
+        """/debug/scrub: paced-scrubber status; POST ?run=1 forces one
+        full cycle NOW and returns its report (how tests and the scrub
+        soak drive deterministic passes). Under -workers, GET merges
+        every sibling's status like /status — each worker scrubs its
+        own partition."""
+        if req.method == "POST":
+            if req.query.get("run", "") not in ("1", "true"):
+                return web.json_response(
+                    {"error": "POST wants ?run=1"}, status=400)
+            report = await self.scrubber.run_cycle()
+            out = {"cycle": report, "status": self.scrubber.status()}
+            wc = self.worker_ctx
+            if wc is not None and not self._is_worker_hop(req):
+                # each worker scrubs only its own vid partition: a
+                # forced cycle must fan out to every sibling or ~1/N
+                # of the host's volumes silently go unscanned
+                out = {"workers": {str(wc.index): out}}
+
+                async def one(i: int) -> None:
+                    addr = wc.sibling_addr(i)
+                    if addr is None:
+                        return
+                    try:
+                        await failpoints.fail("scrub.fanout")
+                        async with self._http.post(
+                                tls.url(addr, "/debug/scrub"),
+                                params={"run": "1"},
+                                headers={_wk().WORKER_HEADER: wc.token},
+                                timeout=aiohttp.ClientTimeout(
+                                    total=600)) as r:
+                            out["workers"][str(i)] = await r.json()
+                    except (aiohttp.ClientError, asyncio.TimeoutError,
+                            OSError, ValueError) as e:
+                        glog.warning("scrub fan-out to worker %d: %s",
+                                     i, e)
+                        out["workers"][str(i)] = {"error": str(e)}
+
+                await asyncio.gather(*(one(i) for i in range(wc.total)
+                                       if i != wc.index))
+            return web.json_response(out)
+        if req.method != "GET":
+            return web.json_response({"error": "method not allowed"},
+                                     status=405)
+        payload: dict = {"scrub": self.scrubber.status()}
+        wc = self.worker_ctx
+        if wc is not None and not self._is_worker_hop(req):
+            payload["workers"] = {str(wc.index): payload.pop("scrub")}
+            for i, body in await self._sibling_get("/debug/scrub"):
+                try:
+                    sib = json.loads(body)
+                except ValueError:
+                    continue
+                if "scrub" in sib:
+                    payload["workers"][str(i)] = sib["scrub"]
         return web.json_response(payload)
 
     async def h_breakers(self, req: web.Request) -> web.Response:

@@ -39,6 +39,7 @@ from ..storage.needle import (FLAG_HAS_LAST_MODIFIED,
 from ..storage.store import BatchBudgetExceeded
 from ..storage.volume import AlreadyDeleted, NotFound, VolumeError
 from ..ec.ec_volume import EcVolumeError
+from ..ec import scrub as ec_scrub
 from ..util import batchframe, failpoints, glog, tracing
 from ..util.httprange import RangeError, parse_range
 from ..security import tls
@@ -114,10 +115,15 @@ def json_ok(obj: dict, status: int = 200) -> WireResponse:
 
 
 def observe(vs, op: str, t0: float) -> None:
+    dur = time.perf_counter() - t0
+    # the scrub pacer's pause-on-foreground-latency signal is THIS
+    # feed — the same durations the request-seconds histogram sees, so
+    # the pacer and the dashboards agree on what "foreground latency"
+    # means (one lock-free deque append; see ec/scrub.ForegroundLoad)
+    ec_scrub.foreground.note(dur)
     from ..stats import metrics
     if metrics.HAVE_PROMETHEUS:
-        metrics.VOLUME_REQUEST_TIME.labels(op).observe(
-            time.perf_counter() - t0)
+        metrics.VOLUME_REQUEST_TIME.labels(op).observe(dur)
 
 
 # tiny cache of formatted Last-Modified values: needles written in the

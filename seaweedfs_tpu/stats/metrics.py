@@ -75,6 +75,27 @@ if HAVE_PROMETHEUS:
         "SeaweedFS_metrics_push_errors_total",
         "failed pushes to the configured metrics gateway",
         registry=REGISTRY)
+    # background EC parity scrubber (ec/scrub.py)
+    SCRUB_BYTES = Counter(
+        "SeaweedFS_scrub_scanned_bytes_total",
+        "shard bytes read by the EC parity scrubber",
+        registry=REGISTRY)
+    SCRUB_WINDOWS = Counter(
+        "SeaweedFS_scrub_windows_total",
+        "stripe windows scrubbed, by parity-check result",
+        ["result"], registry=REGISTRY)
+    SCRUB_CORRUPTIONS = Counter(
+        "SeaweedFS_scrub_corruptions_total",
+        "corrupt stripe windows detected by the scrubber",
+        registry=REGISTRY)
+    SCRUB_PAUSES = Counter(
+        "SeaweedFS_scrub_pauses_total",
+        "scrub pauses yielding to hot foreground traffic",
+        registry=REGISTRY)
+    SCRUB_CYCLES = Counter(
+        "SeaweedFS_scrub_cycles_total",
+        "completed whole-store scrub cycles",
+        registry=REGISTRY)
 
     def metrics_text() -> bytes:
         return generate_latest(REGISTRY)

@@ -166,6 +166,12 @@ class Store:
         # single-interval and batched (one request per holder) forms
         self.fetch_remote_shard = None
         self.fetch_remote_shard_batch = None
+        # repair-planning hooks (volume server layer): peek the cached
+        # holder map with no I/O (fn(vid) -> {sid: holder} | None) and
+        # force one holder re-resolve after a failed batch gather
+        # (fn(vid) -> None) — ec_volume._repair_plan / _recover_interval
+        self.ec_holder_peek = None
+        self.ec_refresh_holders = None
         for d in dirs:
             os.makedirs(d, exist_ok=True)
             self._load_existing(d)
@@ -233,9 +239,24 @@ class Store:
                       fetch_remote=self._make_remote_fetcher(vid),
                       fetch_remote_batch=self._make_remote_batch_fetcher(
                           vid),
-                      recover_cache=self.ec_recover_cache)
+                      recover_cache=self.ec_recover_cache,
+                      holder_peek=self._make_holder_peek(vid),
+                      refresh_holders=self._make_holder_refresh(vid))
         self.ec_volumes[vid] = ev
         return ev
+
+    def _make_holder_peek(self, vid: int):
+        def peek():
+            if self.ec_holder_peek is None:
+                return None
+            return self.ec_holder_peek(vid)
+        return peek
+
+    def _make_holder_refresh(self, vid: int):
+        def refresh():
+            if self.ec_refresh_holders is not None:
+                self.ec_refresh_holders(vid)
+        return refresh
 
     def _make_remote_fetcher(self, vid: int):
         def fetch(shard_id: int, offset: int, size: int):
@@ -624,6 +645,10 @@ class Store:
                 if f is not None:
                     f.close()
                 bits = pb.shard_bits_add(bits, sid)
+            # the missing-set changed: repair plans keyed on it are
+            # stale (a plan could otherwise route a recover at a
+            # just-closed local fd)
+            ev.invalidate_plans()
             self.deleted_ec_shards.append(
                 pb.VolumeEcShardInformationMessage(
                     id=vid, collection=ev.collection, ec_index_bits=bits))

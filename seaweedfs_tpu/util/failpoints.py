@@ -15,6 +15,10 @@ Actions (spec grammar ``action[=arg][:count][@probability]``):
                    on the volume read path this serves a partial body
                    with a full Content-Length, then drops the socket
     drop           sever the connection / raise a connection error
+    flip           silently corrupt the payload: XOR 0xFF into the
+                   first N bytes (arg = N, default 1) — bit-rot the
+                   EC scrubber must detect (payload sites only; a
+                   non-payload site treats it as a no-op)
 
 ``count`` bounds how many times the site fires before auto-disarming
 (default 1; ``*`` = unlimited); ``@probability`` makes each pass fire
@@ -88,7 +92,7 @@ _sites: dict[str, _Armed] = {}
 _lock = threading.Lock()
 _rng = random.Random()
 
-_ACTIONS = ("error", "latency", "truncate", "drop")
+_ACTIONS = ("error", "latency", "truncate", "drop", "flip")
 
 
 def parse_spec(site: str, spec: str) -> _Armed:
@@ -122,6 +126,10 @@ def parse_spec(site: str, spec: str) -> _Armed:
         if not 0.0 <= f < 1.0:
             raise ValueError(f"failpoint {site}: truncate fraction {arg} "
                              f"not in [0, 1)")
+    if action == "flip" and arg:
+        if int(arg) < 1:
+            raise ValueError(f"failpoint {site}: flip byte count {arg} "
+                             f"must be >= 1")
     return _Armed(site, action, arg, count, prob)
 
 
@@ -183,6 +191,8 @@ def _raise_for(a: _Armed) -> None:
         raise FailpointError(a.site, int(a.arg or 500))
     if a.action == "drop":
         raise FailpointDrop(a.site)
+    # flip is payload-only: at a non-payload site it is a no-op (the
+    # fire is still consumed, so counts stay honest)
 
 
 def sync_fail(site: str) -> None:
@@ -216,7 +226,9 @@ async def fail(site: str) -> None:
 
 def corrupt(site: str, data: bytes) -> bytes:
     """Payload site: `truncate` cuts data to the armed keep-fraction
-    (default half); other actions behave as in sync_fail."""
+    (default half); `flip` XORs 0xFF into the first N bytes (silent
+    bit-rot — same length, wrong content); other actions behave as in
+    sync_fail."""
     if not _sites:
         return data
     a = take(site)
@@ -225,6 +237,9 @@ def corrupt(site: str, data: bytes) -> bytes:
     if a.action == "truncate":
         keep = float(a.arg) if a.arg else 0.5
         return data[:int(len(data) * keep)]
+    if a.action == "flip":
+        n = min(int(a.arg or 1), len(data))
+        return bytes(b ^ 0xFF for b in data[:n]) + data[n:]
     if a.action == "latency":
         time.sleep(float(a.arg or 0) / 1000.0)
         return data

@@ -125,6 +125,45 @@ def test_rebuild_missing_shards(ec_fixture, tmp_path):
             assert hashlib.sha256(f.read()).hexdigest() == originals[i], i
 
 
+def test_rebuild_sequential_matches_batched(ec_fixture, tmp_path):
+    """The bench baseline: sequential per-shard rebuild produces
+    byte-identical shards to the batched one-matmul-per-window path,
+    and the stats show batched reading the survivors ONCE while
+    sequential re-reads them per lost shard."""
+    import shutil
+    d, base, _ = ec_fixture
+    lost = (2, 6, 11, 13)
+    originals = {}
+    dirs = {}
+    for mode in ("seq", "batch"):
+        scratch = str(tmp_path / mode)
+        os.makedirs(scratch)
+        dirs[mode] = os.path.join(scratch, "5")
+        for i in range(14):
+            src = base + pl.to_ext(i)
+            if mode == "seq":
+                with open(src, "rb") as f:
+                    originals[i] = hashlib.sha256(f.read()).hexdigest()
+            if i not in lost:
+                shutil.copy(src, dirs[mode] + pl.to_ext(i))
+    stats = {"seq": {}, "batch": {}}
+    assert sorted(pl.rebuild_ec_files(
+        dirs["seq"], encoder=pl.get_encoder("cpu"), sequential=True,
+        stats=stats["seq"])) == list(lost)
+    assert sorted(pl.rebuild_ec_files(
+        dirs["batch"], encoder=pl.get_encoder("cpu"),
+        stats=stats["batch"])) == list(lost)
+    for mode in ("seq", "batch"):
+        for i in lost:
+            with open(dirs[mode] + pl.to_ext(i), "rb") as f:
+                assert hashlib.sha256(
+                    f.read()).hexdigest() == originals[i], (mode, i)
+    assert stats["seq"]["bytes_read"] == \
+        len(lost) * stats["batch"]["bytes_read"]
+    assert stats["seq"]["bytes_rebuilt"] == stats["batch"]["bytes_rebuilt"]
+    assert stats["batch"]["launches"] < stats["seq"]["launches"]
+
+
 def test_rebuild_unrepairable(tmp_path, ec_fixture):
     import shutil
     d, base, _ = ec_fixture

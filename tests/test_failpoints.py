@@ -35,11 +35,13 @@ def test_parse_spec_forms():
     assert fp.parse_spec("s", "error@0.05").count == -1
     # ...unless a count is explicit
     assert fp.parse_spec("s", "error:2@0.5").count == 2
+    a = fp.parse_spec("s", "flip=3:2")
+    assert (a.action, a.arg, a.count) == ("flip", "3", 2)
 
 
 def test_parse_spec_rejects_garbage():
     for bad in ("explode", "error@1.5", "error@0", "truncate=2",
-                "latency=abc", "error=xyz"):
+                "latency=abc", "error=xyz", "flip=0", "flip=-1"):
         with pytest.raises(ValueError):
             fp.parse_spec("s", bad)
 
@@ -77,6 +79,16 @@ def test_corrupt_truncates_payload():
     fp.arm("t", "truncate=0.25")
     assert fp.corrupt("t", b"x" * 100) == b"x" * 25
     assert fp.corrupt("t", b"x" * 100) == b"x" * 100  # expired
+
+
+def test_corrupt_flips_payload_silently():
+    """`flip` is bit-rot: same length, corrupt prefix — what the EC
+    scrubber (ec/scrub.py) must catch without a foreground error."""
+    fp.arm("f", "flip")
+    out = fp.corrupt("f", b"\x0f" * 4)
+    assert out == b"\xf0" + b"\x0f" * 3 and len(out) == 4
+    fp.arm("f2", "flip=100")             # clamps to payload length
+    assert fp.corrupt("f2", b"\x00" * 3) == b"\xff" * 3
 
 
 def test_disarmed_is_free_and_noop():

@@ -135,6 +135,34 @@ def test_orphan_task_quiet_when_retained_or_awaited(tmp_path):
     assert found == []
 
 
+def test_orphan_task_long_lived_paced_background_loop(tmp_path):
+    """The scrubber pattern (ec/scrub.py): a long-lived paced
+    background task (`while True: work; await sleep(interval)`) whose
+    handle is dropped is exactly the GC-cancellation class the rule
+    exists for — the loop silently dies mid-flight and nothing scrubs
+    again. Retaining the handle for cancel-on-stop is quiet."""
+    found = probs(tmp_path, """
+        import asyncio
+        class Server:
+            async def start(self):
+                # paced background loop, handle dropped: flagged
+                asyncio.create_task(self.scrubber.run())
+    """, select=["orphan-task"])
+    assert rule_ids(found) == ["orphan-task"]
+    found = probs(tmp_path, """
+        import asyncio
+        class Server:
+            async def start(self):
+                # volume_server.py's shape: retained + cancelled in stop
+                self._tasks.append(
+                    asyncio.create_task(self.scrubber.run()))
+            async def stop(self):
+                for t in self._tasks:
+                    t.cancel()
+    """, select=["orphan-task"])
+    assert found == []
+
+
 def test_await_in_lock_fires_under_sync_lock(tmp_path):
     found = probs(tmp_path, """
         async def h(self):
@@ -303,6 +331,28 @@ def test_failpoint_site_quiet_with_site_or_outside_scope(tmp_path):
             async with self._http.get(url) as r:    # shell/: no scope
                 return await r.read()
     """, name="seaweedfs_tpu/shell/helper.py",
+        select=["failpoint-site"])
+    assert found == []
+
+
+def test_failpoint_site_covers_ec_recovery_plane(tmp_path):
+    """The EC degraded-read/scrub I/O (ec_volume.py, scrub.py) is in
+    failpoint scope: a raw shard pread without a site in reach is a
+    recovery path the chaos soak can never break."""
+    found = probs(tmp_path, """
+        import os
+        def _read_shard_interval(self, sid, offset, size):
+            return os.pread(self.shards[sid].fileno(), size, offset)
+    """, name="seaweedfs_tpu/ec/ec_volume.py",
+        select=["failpoint-site"])
+    assert rule_ids(found) == ["failpoint-site"]
+    found = probs(tmp_path, """
+        import os
+        from seaweedfs_tpu.util import failpoints
+        def _read_shard_interval(self, sid, offset, size):
+            failpoints.sync_fail("ec.shard_read")
+            return os.pread(self.shards[sid].fileno(), size, offset)
+    """, name="seaweedfs_tpu/ec/scrub.py",
         select=["failpoint-site"])
     assert found == []
 

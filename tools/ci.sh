@@ -44,6 +44,14 @@ if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/wire_smoke.py; then
     exit 1
 fi
 
+echo "== ec repair-bandwidth smoke (minimal-fetch + batched rebuild) =="
+if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/bench_ec.py --smoke; then
+    echo "bench_ec smoke: FAILED (repair-bandwidth regression — minimal-"
+    echo "fetch must move strictly fewer bytes than the all-survivor"
+    echo "gather and batched rebuild must beat sequential; see above)"
+    exit 1
+fi
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \

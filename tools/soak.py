@@ -17,6 +17,11 @@ over real sockets, and byte-verifies every surviving file at the end.
                                        # zipf reads racing overwrites/deletes
                                        # with failpoints armed, every read
                                        # byte-verified (zero stale tolerated)
+    python tools/soak.py scrub         # paced parity scrubber vs planted
+                                       # bit-rot (real on-disk + scrub.read
+                                       # flip failpoint): every corruption
+                                       # reported, zero foreground read
+                                       # errors, byte budget held
     python tools/soak.py all
 
 Exit code 0 only when every read verifies.
@@ -830,6 +835,155 @@ async def scenario_cache_churn(tmp: str) -> int:
         procs.kill_all()
 
 
+def _http_json(port: int, path: str, method: str = "GET") -> dict:
+    import json as _json
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method=method)
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return _json.loads(r.read())
+
+
+async def scenario_scrub(tmp: str) -> int:
+    """Silent-corruption hunt under pacing: one volume server holds
+    every EC shard, real bit-rot is planted on disk in parity shards
+    (bytes no foreground needle read ever visits) AND the scrub.read
+    failpoint is armed with `flip`, while foreground reads hammer the
+    same volumes. The paced scrubber must report EVERY planted
+    corruption, cause ZERO foreground read errors, and hold its token-
+    bucket byte budget (the pacing floor is asserted from the cycle
+    report)."""
+    import glob as _glob
+
+    from seaweedfs_tpu.util.client import WeedClient
+    procs = Procs(tmp)
+    failures = 0
+    mbps = 8.0
+    try:
+        port0 = BASE_PORT + 80
+        master = f"127.0.0.1:{port0}"
+        procs.spawn("master", "-port", str(port0),
+                    "-mdir", os.path.join(procs.tmp, "m"),
+                    "-volumeSizeLimitMB", "8", "-pulseSeconds", "1")
+        await asyncio.sleep(2)
+        vport = port0 + 1
+        vdir = os.path.join(procs.tmp, "v")
+        procs.spawn("volume", "-port", str(vport), "-dir", vdir,
+                    "-max", "20", "-master", master,
+                    "-pulseSeconds", "1",
+                    "-scrub.mbps", str(mbps),
+                    "-scrub.interval", "3600",   # loop alive, cycles
+                    "-scrub.pausems", "500")     # driven via ?run=1
+        wait_assign(master)
+        rng = random.Random(77)
+        payloads: dict = {}
+        async with WeedClient(master) as c:
+            await fill(c, payloads, 900, rng, replication="000")
+            await asyncio.to_thread(
+                procs.shell, master, "ec.encode -fullPercent 1")
+            bad = await verify(c, payloads, "after ec.encode")
+
+            vids = sorted(int(os.path.basename(p)[:-4])
+                          for p in _glob.glob(os.path.join(vdir,
+                                                           "*.ecx")))
+            if len(vids) < 2:
+                print(f"  want >=2 EC volumes, got {vids}")
+                return bad + 1
+            # real on-disk bit rot in a PARITY shard of every volume
+            # but the first (shard files < 4MB => scrub window 0)
+            def flip_byte(path: str, off: int) -> None:
+                with open(path, "r+b") as f:
+                    f.seek(off)
+                    b = f.read(1)
+                    f.seek(off)
+                    f.write(bytes([b[0] ^ 0xFF]))
+
+            planted = []
+            for vid in vids[1:]:
+                await asyncio.to_thread(
+                    flip_byte, os.path.join(vdir, f"{vid}.ec12"), 4321)
+                planted.append(vid)
+            # failpoint-injected corruption lands in the FIRST
+            # scrubbed volume's first window (2 row reads flipped)
+            await asyncio.to_thread(
+                _failpoints, vport, "POST",
+                "?site=scrub.read&spec=flip:2")
+            expected = {(vids[0], 0)} | {(v, 0) for v in planted}
+
+            # foreground reads run THROUGH the scrub cycle: zero
+            # errors tolerated (the scrubber must never disturb them)
+            stop = asyncio.Event()
+            fg = {"reads": 0, "errors": 0}
+            sample = dict(rng.sample(sorted(payloads.items()), 200))
+
+            async def forever_reads() -> None:
+                while not stop.is_set():
+                    for fid, want in sample.items():
+                        if stop.is_set():
+                            break
+                        try:
+                            got = await c.read(fid)
+                        except Exception as e:  # noqa: BLE001
+                            print(f"  FG ERROR {fid}: "
+                                  f"{type(e).__name__} {e}")
+                            fg["errors"] += 1
+                            continue
+                        fg["reads"] += 1
+                        if got != want:
+                            print(f"  FG STALE {fid}")
+                            fg["errors"] += 1
+
+            readers = [asyncio.create_task(forever_reads())
+                       for _ in range(2)]
+            body = await asyncio.to_thread(
+                _http_json, vport, "/debug/scrub?run=1", "POST")
+            stop.set()
+            await asyncio.gather(*readers)
+            cycle, status = body["cycle"], body["status"]
+            reported = {(r["volume"], r["offset"])
+                        for r in status["corruptions"]}
+            print(f"  cycle 1: {cycle['volumes']} volumes, "
+                  f"{cycle['windows']} windows, "
+                  f"{cycle['bytes'] / (1 << 20):.1f}MB in "
+                  f"{cycle['seconds']}s, corrupt={cycle['corrupt']}, "
+                  f"paced_sleep={status['paced_sleep_s']}s; "
+                  f"foreground: {fg['reads']} reads "
+                  f"{fg['errors']} errors")
+            if reported != expected:
+                print(f"  MISSED/extra corruption: reported="
+                      f"{sorted(reported)} expected={sorted(expected)}")
+                failures += 1
+            if cycle["skipped"]:
+                print(f"  unexpected skips: {cycle['skipped']}")
+                failures += 1
+            # pacing floor: every byte past the burst was paid for at
+            # -scrub.mbps; a cycle faster than that broke the budget
+            rate = mbps * (1 << 20)
+            floor = max(0.0, (cycle["bytes"] - rate) / rate)
+            if cycle["seconds"] < floor * 0.95:
+                print(f"  BUDGET BROKEN: {cycle['bytes']}B in "
+                      f"{cycle['seconds']}s < floor {floor:.2f}s")
+                failures += 1
+            if floor > 0 and status["paced_sleep_s"] <= 0:
+                print("  pacing never engaged (paced_sleep_s == 0)")
+                failures += 1
+            failures += fg["errors"]
+
+            # cycle 2: the failpoint is spent, the REAL bit rot
+            # persists and must be re-detected every pass
+            body = await asyncio.to_thread(
+                _http_json, vport, "/debug/scrub?run=1", "POST")
+            c2 = body["cycle"]
+            print(f"  cycle 2: corrupt={c2['corrupt']} "
+                  f"(want {len(planted)}: real rot persists, "
+                  f"failpoint spent)")
+            if c2["corrupt"] != len(planted):
+                failures += 1
+            bad += await verify(c, payloads, "after scrub cycles")
+            return bad + failures
+    finally:
+        procs.kill_all()
+
+
 SCENARIOS = {
     "ec": scenario_ec,
     "vacuum-race": scenario_vacuum_race,
@@ -838,6 +992,7 @@ SCENARIOS = {
     "partition": scenario_partition,
     "workers": scenario_workers,
     "cache-churn": scenario_cache_churn,
+    "scrub": scenario_scrub,
 }
 
 

@@ -34,7 +34,13 @@ _SESSIONISH = re.compile(r"(?i)(sess|session|http|client)$")
 FAILPOINT_SCOPE = ("seaweedfs_tpu/server/", "seaweedfs_tpu/replication/",
                    "seaweedfs_tpu/util/client.py",
                    "seaweedfs_tpu/util/masterclient.py",
-                   "seaweedfs_tpu/storage/store.py")
+                   "seaweedfs_tpu/storage/store.py",
+                   # the EC recovery data plane: degraded-read shard
+                   # preads + the scrubber's window reads must sit
+                   # within chaos-site reach (ec.shard_read,
+                   # ec.recover.read, scrub.read)
+                   "seaweedfs_tpu/ec/ec_volume.py",
+                   "seaweedfs_tpu/ec/scrub.py")
 
 
 def _mentions_evidence(fn: ast.AST, spec: re.Pattern) -> bool:
