@@ -54,6 +54,20 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    default=2048,
                    help="finished spans kept in the per-process "
                         "/debug/traces ring buffer")
+    p.add_argument("-timeline.interval", dest="timeline_interval",
+                   type=float, default=10.0,
+                   help="metrics-timeline snapshot cadence in seconds "
+                        "(/debug/timeline flight recorder); 0 disables "
+                        "the recorder on this daemon")
+    p.add_argument("-timeline.ring", dest="timeline_ring", type=int,
+                   default=360,
+                   help="timeline windows kept per process (default "
+                        "360 = 1h of 10s windows)")
+    p.add_argument("-slo", action="append", default=[],
+                   help="declarative latency objective evaluated over "
+                        "the timeline with fast/slow burn-rate windows "
+                        "and served at /debug/health, e.g. "
+                        "'volume.read:p99<50ms@99.9' (repeatable)")
 
 
 def _add_workers(p: argparse.ArgumentParser) -> None:
@@ -569,6 +583,14 @@ def _make_worker_ctx(args, kind: str):
                          _worker_state_dir(args, kind))
 
 
+def _start_recorder(disk_paths: "list[str] | None" = None):
+    """Start the flight-recorder sampling loop for a daemon process
+    (no-op handle when -timeline.interval 0). The caller cancels the
+    returned handle on shutdown."""
+    from .stats import timeline
+    return timeline.start_recorder(disk_paths=disk_paths)
+
+
 async def _run_master(args) -> None:
     from .master.server import MasterServer
     if args.workers > 1 and args.workerIndex < 0:
@@ -584,8 +606,13 @@ async def _run_master(args) -> None:
             jwt_key=args.jwtKey,
             default_replication=args.defaultReplication)
         await acc.start()
+        rec = _start_recorder()
         print(f"master assign worker {args.workerIndex} on {acc.url}")
-        await _serve_until_interrupt(acc)
+        try:
+            await _serve_until_interrupt(acc)
+        finally:
+            if rec is not None:
+                rec.cancel()
         return
     worker_ctx = None
     if args.workerIndex == 0:
@@ -618,12 +645,15 @@ async def _run_master(args) -> None:
         from .stats.metrics import push_loop
         push_task = asyncio.create_task(
             push_loop(args.metricsGateway, "master"))
+    rec = _start_recorder()
     print(f"master listening on {m.url}")
     try:
         await _serve_until_interrupt(m)
     finally:
         if push_task is not None:
             push_task.cancel()
+        if rec is not None:
+            rec.cancel()
 
 
 async def _run_volume(args) -> None:
@@ -672,13 +702,18 @@ async def _run_volume(args) -> None:
                       scrub_interval=args.scrub_interval,
                       scrub_pause_ms=args.scrub_pause_ms)
     await vs.start()
+    rec = _start_recorder(disk_paths=dirs)
     if worker_ctx is not None:
         print(f"volume worker {worker_ctx.index}/{worker_ctx.total}: "
               f"public {args.ip}:{worker_ctx.public_port}, "
               f"private {vs.url}, dirs={dirs}")
     else:
         print(f"volume server listening on {vs.url}, dirs={dirs}")
-    await _serve_until_interrupt(vs)
+    try:
+        await _serve_until_interrupt(vs)
+    finally:
+        if rec is not None:
+            rec.cancel()
 
 
 def _store_kwargs(store: str, db_path: str) -> dict:
@@ -711,8 +746,13 @@ async def _run_filer(args) -> None:
                      cache_mem_bytes=args.cache_mem * 1024 * 1024,
                      cache_dir=args.cache_dir)
     await fs.start()
+    rec = _start_recorder()
     print(f"filer listening on {fs.url} (store={args.store})")
-    await _serve_until_interrupt(fs)
+    try:
+        await _serve_until_interrupt(fs)
+    finally:
+        if rec is not None:
+            rec.cancel()
 
 
 def _make_queue(spec: str):
@@ -904,8 +944,13 @@ async def _run_s3(args) -> None:
                    cache_mem_bytes=args.cache_mem * 1024 * 1024,
                    cache_dir=args.cache_dir)
     await s3.start()
+    rec = _start_recorder()
     print(f"s3 gateway listening on {s3.url}")
-    await _serve_until_interrupt(s3)
+    try:
+        await _serve_until_interrupt(s3)
+    finally:
+        if rec is not None:
+            rec.cancel()
 
 
 async def _run_webdav(args) -> None:
@@ -922,8 +967,13 @@ async def _run_webdav(args) -> None:
                       cache_mem_bytes=args.cache_mem * 1024 * 1024,
                       cache_dir=args.cache_dir)
     await wd.start()
+    rec = _start_recorder()
     print(f"webdav listening on {wd.url} (store={args.store})")
-    await _serve_until_interrupt(wd)
+    try:
+        await _serve_until_interrupt(wd)
+    finally:
+        if rec is not None:
+            rec.cancel()
 
 
 async def _run_server(args) -> None:
@@ -960,9 +1010,14 @@ async def _run_server(args) -> None:
         await s3.start()
         parts.append(f"s3={s3.url}")
     print("server up: " + " ".join(parts))
+    rec = _start_recorder(disk_paths=[args.dir])
     # data plane drains before the control plane disappears
-    await _serve_until_interrupt(*[srv for srv in (s3, filer_srv, vs, m)
-                                   if srv is not None])
+    try:
+        await _serve_until_interrupt(*[srv for srv in (s3, filer_srv, vs, m)
+                                       if srv is not None])
+    finally:
+        if rec is not None:
+            rec.cancel()
 
 
 def _walk_upload_files(dir_path: str, include: str) -> list[str]:
@@ -1657,6 +1712,49 @@ def main(argv: list[str] | None = None) -> None:
                   logtostderr=args.logtostderr)
         tracing.init(sample=args.trace_sample, slow_ms=args.trace_slowms,
                      ring=args.trace_ring)
+        from .stats import slo, timeline
+        timeline.init(interval_s=args.timeline_interval,
+                      ring=args.timeline_ring)
+        try:
+            slo.init(args.slo)
+        except ValueError as e:
+            # refuse to start guarding nothing: a typo'd objective
+            # silently ignored would "pass" every soak
+            raise SystemExit(str(e))
+        if args.slo and not timeline.enabled():
+            # same hazard as a typo'd spec: with the recorder off no
+            # window is ever snapped, slo.tick() never runs, and
+            # /debug/health reports ok forever no matter the damage
+            raise SystemExit(
+                "-slo needs the flight recorder: -timeline.interval 0 "
+                "disables the timeline the burn engine evaluates")
+        if args.slo:
+            # the ring must hold the slow burn horizon: 360 windows at
+            # -timeline.interval 1 is only 360s of history for a 600s
+            # window — silently evaluating the "slow" burn over less
+            # defeats its blip-suppression role
+            needed = slo.windows_needed(minimum=0)
+            if needed > args.timeline_ring:
+                from .util import glog
+                glog.info("-timeline.ring %d too small for the %ds SLO "
+                          "slow window at interval %gs; using %d",
+                          args.timeline_ring, int(slo.SLOW_WINDOW_S),
+                          args.timeline_interval, needed)
+                timeline.init(interval_s=args.timeline_interval,
+                              ring=needed)
+        if os.environ.get("WEED_WORKER_RESPAWNS"):
+            # set by the -workers supervisor on every respawn (the
+            # supervisor itself serves no HTTP, so the respawned
+            # worker journals the event where /debug/events can see
+            # it and /debug/health can correlate it)
+            from .util import events
+            try:
+                n_respawns = int(os.environ["WEED_WORKER_RESPAWNS"])
+            except ValueError:
+                n_respawns = -1
+            events.record("worker_respawn",
+                          index=getattr(args, "workerIndex", -1),
+                          respawns=n_respawns)
         if args.cpuprofile or args.memprofile:
             from .util.pprof import setup_profiling
             # -workers N: each worker suffixes the dump path with its

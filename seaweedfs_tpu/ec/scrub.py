@@ -36,7 +36,7 @@ import asyncio
 import collections
 import time
 
-from ..util import glog, tracing
+from ..util import events, glog, tracing
 from . import gf
 
 # how long the scrubber sleeps while parked behind hot foreground
@@ -181,7 +181,11 @@ class Scrubber:
         self.pauses = 0          # pause EVENTS (not poll iterations)
         self.paused_s = 0.0      # total time parked behind foreground
         self.paced_sleep_s = 0.0
+        # wall stamp for display, monotonic twin for the uptime DELTA
+        # (an NTP step must not make uptime jump — the wall/monotonic
+        # discipline every merged debug surface follows)
         self.started_at = time.time()
+        self.started_mono = time.monotonic()
         self.corruptions: collections.deque = collections.deque(
             maxlen=self.MAX_REPORTS)
         self.last_cycle: dict | None = None
@@ -314,6 +318,8 @@ class Scrubber:
                            "wall": time.time()}
                     self.corruptions.append(rec)
                     sp.event("corrupt_window", offset=off, size=w)
+                    events.record("scrub_corruption", vid=vid,
+                                  offset=off, size=w)
                     glog.error(
                         "scrub: CORRUPT ec window vid=%d off=%d "
                         "size=%d — stored parity disagrees with "
@@ -339,7 +345,8 @@ class Scrubber:
             "pauses": self.pauses,
             "paused_s": round(self.paused_s, 3),
             "paced_sleep_s": round(self.paced_sleep_s, 3),
-            "uptime_s": round(time.time() - self.started_at, 1),
+            "started_wall": round(self.started_at, 3),
+            "uptime_s": round(time.monotonic() - self.started_mono, 1),
             "corruptions": list(self.corruptions),
             "last_cycle": self.last_cycle,
         }

@@ -175,6 +175,15 @@ class MasterServer:
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_route("*", "/debug/failpoints",
                              failpoints.handle_debug)
+        # flight recorder: shared handler trio (stats/timeline.py), so
+        # the master serves the same /debug/timeline//events//health
+        # contract as every data-plane daemon
+        from ..stats.timeline import recorder_handlers
+        h_tl, h_ev, h_hl = recorder_handlers()
+        app.router.add_get("/debug/timeline", h_tl)
+        app.router.add_post("/debug/timeline", h_tl)
+        app.router.add_get("/debug/events", h_ev)
+        app.router.add_get("/debug/health", h_hl)
         app.router.add_route("*", "/vol/grow", self.h_grow)
         app.router.add_route("*", "/vol/vacuum", self.h_vacuum)
         app.router.add_route("*", "/col/delete", self.h_collection_delete)

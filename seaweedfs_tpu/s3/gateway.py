@@ -99,6 +99,13 @@ class S3Gateway:
         h_traces, h_requests = tracing.debug_handlers()
         app.router.add_get("/__debug__/traces", h_traces)
         app.router.add_get("/__debug__/requests", h_requests)
+        # flight-recorder twins: same shared trio as master/filer/WebDAV
+        from ..stats.timeline import recorder_handlers
+        h_tl, h_ev, h_hl = recorder_handlers()
+        app.router.add_get("/__debug__/timeline", h_tl)
+        app.router.add_post("/__debug__/timeline", h_tl)
+        app.router.add_get("/__debug__/events", h_ev)
+        app.router.add_get("/__debug__/health", h_hl)
         # "*": with -domainName, PUT/DELETE bucket.domain/ are bucket
         # operations that land on the root path
         app.router.add_route("*", "/", self.h_list_buckets)

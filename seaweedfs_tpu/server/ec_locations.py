@@ -106,5 +106,11 @@ class EcLocationCache:
             if now - e.last_forced >= self.FRESH_S:
                 e.attempted_at = -1e9
                 e.last_forced = now
+                # journal the forced refresh (rate-bounded to one per
+                # FRESH_S per vid by construction): a degraded-read
+                # burst chasing a moved holder map is core evidence for
+                # a latency violation window
+                from ..util import events
+                events.record("holder_refresh", vid=vid)
                 return True
             return False

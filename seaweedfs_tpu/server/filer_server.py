@@ -106,6 +106,14 @@ class FilerServer:
         h_traces, h_requests = tracing.debug_handlers()
         app.router.add_get("/__debug__/traces", h_traces)
         app.router.add_get("/__debug__/requests", h_requests)
+        # flight-recorder twins (stats/timeline.py): timeline, event
+        # journal, SLO health — same shared trio as master/S3/WebDAV
+        from ..stats.timeline import recorder_handlers
+        h_tl, h_ev, h_hl = recorder_handlers()
+        app.router.add_get("/__debug__/timeline", h_tl)
+        app.router.add_post("/__debug__/timeline", h_tl)
+        app.router.add_get("/__debug__/events", h_ev)
+        app.router.add_get("/__debug__/health", h_hl)
         # reserved-prefix path (like /__api__, /__debug__) so a stored
         # file named /metrics is never shadowed; exposes the chunk-cache
         # hit/miss/byte counters among the rest of the registry

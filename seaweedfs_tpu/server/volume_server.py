@@ -17,6 +17,7 @@ import os
 import random
 import re
 import time
+import urllib.parse
 
 import aiohttp
 from aiohttp import web
@@ -283,6 +284,11 @@ class VolumeServer:
         app.router.add_get("/debug/breakers", self.h_breakers)
         app.router.add_get("/debug/traces", self.h_traces)
         app.router.add_get("/debug/requests", self.h_requests)
+        # flight recorder: metrics timelines, event journal, SLO health
+        app.router.add_get("/debug/timeline", self.h_timeline)
+        app.router.add_post("/debug/timeline", self.h_timeline)
+        app.router.add_get("/debug/events", self.h_events)
+        app.router.add_get("/debug/health", self.h_health)
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_get("/stats/workers", self.h_stats_workers)
@@ -1045,7 +1051,8 @@ class VolumeServer:
         return wc is not None and \
             wc.token_ok(req.headers.get(_wk().WORKER_HEADER))
 
-    async def _sibling_get(self, path: str) -> "list[tuple[int, bytes]]":
+    async def _sibling_fetch(self, path: str, method: str,
+                             timeout_s: float) -> "list[tuple[int, bytes]]":
         """Fetch `path` from every live sibling worker (token-marked so
         they answer locally instead of re-aggregating)."""
         wc = self.worker_ctx
@@ -1057,10 +1064,11 @@ class VolumeServer:
                 return
             try:
                 await failpoints.fail("worker.fanout")
-                async with self._http.get(
-                        tls.url(addr, path),
+                async with self._http.request(
+                        method, tls.url(addr, path),
                         headers={_wk().WORKER_HEADER: wc.token},
-                        timeout=aiohttp.ClientTimeout(total=3)) as r:
+                        timeout=aiohttp.ClientTimeout(
+                            total=timeout_s)) as r:
                     if r.status == 200:
                         out.append((i, await r.read()))
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
@@ -1073,6 +1081,9 @@ class VolumeServer:
         await asyncio.gather(*(one(i) for i in range(wc.total)
                                if i != wc.index))
         return out
+
+    async def _sibling_get(self, path: str) -> "list[tuple[int, bytes]]":
+        return await self._sibling_fetch(path, "GET", 3)
 
     async def h_metrics(self, req: web.Request) -> web.Response:
         """/metrics; under -workers, any worker answers for the whole
@@ -1125,8 +1136,8 @@ class VolumeServer:
         span ring; under -workers, any worker answers for the whole
         host by merging its siblings' rings (like /metrics)."""
         try:
-            recent = int(req.query.get("n", 20))
-            slowest = int(req.query.get("slowest", 10))
+            recent = tracing.clamp_count(req.query.get("n", 20))
+            slowest = tracing.clamp_count(req.query.get("slowest", 10))
             payload = tracing.traces_dict(recent=recent, slowest=slowest)
         except ValueError:
             return web.json_response({"error": "bad n/slowest"},
@@ -1162,6 +1173,126 @@ class VolumeServer:
             rows.sort(key=lambda r: -r.get("age_ms", 0))
             payload = {"inflight": len(rows), "requests": rows}
         return web.json_response(payload)
+
+    # ---- flight recorder (stats/timeline.py, util/events.py,
+    # stats/slo.py): every surface whole-host merged under -workers
+    # with the same discipline as /metrics ----
+
+    async def _merged_timeline(self, req: web.Request, n: int,
+                               force_snap: bool = False,
+                               render: bool = True) -> dict:
+        """This worker's timeline, merged with every sibling's under
+        -workers (rates/gauges/histogram buckets summed per wall
+        bucket, quantiles recomputed from the summed buckets).
+
+        ``render=False`` (the h_health path) skips the per-window
+        quantile interpolation end-to-end: the SLO engine reads only
+        the raw hist deltas, and the merge recomputes quantiles from
+        summed buckets anyway, so rendering inputs is pure waste."""
+        from ..stats import timeline
+        if force_snap:
+            timeline.snap()
+        wc = self.worker_ctx
+        if wc is None or self._is_worker_hop(req):
+            # merge INPUTS never need rendering (the entry worker
+            # recomputes from the summed buckets); only a final
+            # payload handed straight to a caller does
+            merging = wc is not None
+            return timeline.timeline_dict(
+                n=n, render=render and not merging)
+        payloads = [timeline.timeline_dict(n=n, render=False)]
+        snap_q = "&snap=1" if force_snap else ""
+        path = f"/debug/timeline?n={n}{snap_q}"
+        for _, body in await (
+                self._sibling_post(path) if force_snap
+                else self._sibling_get(path)):
+            try:
+                payloads.append(json.loads(body))
+            except ValueError:
+                continue
+        return timeline.merge_payloads(payloads, n=n, render=render)
+
+    async def _sibling_post(self, path: str) -> "list[tuple[int, bytes]]":
+        """POST twin of _sibling_get (forced timeline snapshots; the
+        longer timeout pays for the sibling's synchronous snap)."""
+        return await self._sibling_fetch(path, "POST", 5)
+
+    async def h_timeline(self, req: web.Request) -> web.Response:
+        """/debug/timeline: the metrics-timeline ring; GET ?n= windows,
+        POST ?snap=1 forces a snapshot NOW (fanned out to siblings so a
+        forced whole-host window aligns)."""
+        from ..stats import timeline
+        force = req.method == "POST"
+        if force and req.query.get("snap", "") not in ("1", "true"):
+            return web.json_response({"error": "POST wants ?snap=1"},
+                                     status=400)
+        try:
+            n = tracing.clamp_count(req.query.get("n", 60), cap=10_000)
+        except ValueError:
+            return web.json_response({"error": "bad n"}, status=400)
+        return web.json_response(
+            await self._merged_timeline(req, n, force_snap=force))
+
+    async def _merged_events(self, req: web.Request,
+                             query) -> dict:
+        from ..util import events
+        payload = events.events_query(query)
+        wc = self.worker_ctx
+        if wc is None or self._is_worker_hop(req):
+            return payload
+        # tag COPIES: events_query hands out the live journal rows, and
+        # stamping them in place would rewrite every ring entry's shape
+        # for all later surfaces (worker-hop responses, slo evidence)
+        payload["events"] = [{**r, "worker": wc.index}
+                             for r in payload["events"]]
+        payloads = [payload]
+        # urlencode, not raw interpolation: a type/since_ms value with
+        # a reserved char would 400 on the sibling and its rows would
+        # silently vanish from the merged journal
+        qs = urllib.parse.urlencode(query)
+        for i, body in await self._sibling_get(
+                "/debug/events" + (f"?{qs}" if qs else "")):
+            try:
+                sib = json.loads(body)
+            except ValueError:
+                continue
+            for r in sib.get("events", ()):
+                r["worker"] = i
+            payloads.append(sib)
+        return events.merge_payloads(
+            payloads, n=int(query.get("n", 100) or 100))
+
+    async def h_events(self, req: web.Request) -> web.Response:
+        """/debug/events: the structured event journal (breaker trips,
+        holder refreshes, scrub corruptions, mounts, respawns, ...)
+        with wall stamps and trace ids; -workers merged, rows tagged
+        with their worker index."""
+        try:
+            payload = await self._merged_events(req, dict(req.query))
+        except ValueError:
+            return web.json_response({"error": "bad n/type/since_ms"},
+                                     status=400)
+        return web.json_response(payload)
+
+    async def h_health(self, req: web.Request) -> web.Response:
+        """/debug/health: the SLO burn-rate verdict (ok/warn/page) with
+        evidence, evaluated over the WHOLE-HOST merged timeline and
+        journal under -workers — the one machine-readable answer soaks
+        and operators assert against."""
+        from ..stats import slo
+        eng = slo.engine()
+        if eng is None or not eng.specs:
+            # no objectives armed: health_dict ignores its arguments
+            # and returns the ok stub — don't pay the sibling
+            # timeline/journal fan-out just to discard it
+            return web.json_response(slo.health_dict([]))
+        timeline_payload, events_payload = await asyncio.gather(
+            self._merged_timeline(req, slo.windows_needed(),
+                                  render=False),
+            self._merged_events(req, {"n": "500"}))
+        return web.json_response(slo.health_dict(
+            timeline_payload["windows"],
+            events=events_payload["events"]))
 
     async def h_scrub(self, req: web.Request) -> web.Response:
         """/debug/scrub: paced-scrubber status; POST ?run=1 forces one

@@ -69,9 +69,12 @@ class WebDavServer:
 
     def _build_app(self) -> web.Application:
         app = web.Application(client_max_size=1024 * 1024 * 1024)
+        # POST is not a WebDAV verb, but the flight-recorder twin
+        # POST /__debug__/timeline?snap=1 needs it; dispatch confines
+        # POST to that one path (anything else 405s, as before)
         for method in ("OPTIONS", "PROPFIND", "PROPPATCH", "MKCOL", "GET",
                        "HEAD", "PUT", "DELETE", "MOVE", "COPY", "LOCK",
-                       "UNLOCK"):
+                       "UNLOCK", "POST"):
             app.router.add_route(method, "/{path:.*}", self.dispatch)
         return app
 
@@ -125,6 +128,18 @@ class WebDavServer:
             h_traces, h_requests = tracing.debug_handlers()
             return await (h_traces if path.endswith("traces")
                           else h_requests)(req)
+        if (req.method == "GET" and path in (
+                "/__debug__/timeline", "/__debug__/events",
+                "/__debug__/health")) or (
+                req.method == "POST" and path == "/__debug__/timeline"):
+            # flight-recorder twins: shared trio, no drift vs filer/S3
+            # (POST only on timeline — ?snap=1 — exactly like the
+            # add_get/add_post registrations on every other daemon)
+            from ..stats.timeline import recorder_handlers
+            h_tl, h_ev, h_hl = recorder_handlers()
+            return await {"/__debug__/timeline": h_tl,
+                          "/__debug__/events": h_ev,
+                          "/__debug__/health": h_hl}[path](req)
         handler = getattr(self, f"h_{req.method.lower()}", None)
         if handler is None:
             return web.Response(status=405)

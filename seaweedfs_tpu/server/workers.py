@@ -225,6 +225,7 @@ class Supervisor:
         self.stable_s = stable_s
         self.procs: dict[int, asyncio.subprocess.Process] = {}
         self.restarts = 0
+        self._respawns: dict[int, int] = {}
         self._stopping = False
         self._tasks: list[asyncio.Task] = []
 
@@ -236,8 +237,16 @@ class Supervisor:
 
     async def _spawn(self, index: int) -> None:
         argv = self.build_argv(index)
+        env = self.env
+        n = self._respawns.get(index, 0)
+        if n:
+            # the respawned worker journals its own worker_respawn
+            # event at boot (cli.main): the supervisor serves no HTTP,
+            # so an event recorded HERE would be unobservable
+            env = dict(env if env is not None else os.environ)
+            env["WEED_WORKER_RESPAWNS"] = str(n)
         self.procs[index] = await asyncio.create_subprocess_exec(
-            *argv, env=self.env)
+            *argv, env=env)
         glog.info("worker %d spawned (pid %d)", index,
                   self.procs[index].pid)
 
@@ -254,6 +263,10 @@ class Supervisor:
             glog.warning("worker %d (pid %d) exited rc=%s; respawning "
                          "in %.1fs", index, p.pid, rc, backoff)
             self.restarts += 1
+            # the respawn event is journaled by the respawned worker at
+            # boot (WEED_WORKER_RESPAWNS via _spawn): the supervisor
+            # serves no HTTP, so a ring entry here would be unobservable
+            self._respawns[index] = self._respawns.get(index, 0) + 1
             await asyncio.sleep(backoff)
             backoff = min(backoff * 2, self.max_backoff)
             if not self._stopping:

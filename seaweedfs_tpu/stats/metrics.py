@@ -9,6 +9,8 @@ scrape endpoint on every server and an optional push loop.
 from __future__ import annotations
 
 import asyncio
+import platform
+import time
 
 try:
     from prometheus_client import (CollectorRegistry, Counter, Gauge,
@@ -96,12 +98,97 @@ if HAVE_PROMETHEUS:
         "SeaweedFS_scrub_cycles_total",
         "completed whole-store scrub cycles",
         registry=REGISTRY)
+    # build/restart detection (scrapes and timelines both need to tell
+    # a counter reset apart from a rate dip): every daemon exports who
+    # it is and when this process was born
+    BUILD_INFO = Gauge(
+        "SeaweedFS_build_info",
+        "constant 1, labeled with the build version and python version",
+        ["version", "pyver"], registry=REGISTRY)
+    PROCESS_START_TIME = Gauge(
+        "SeaweedFS_process_start_time_seconds",
+        "unix time this process imported the metrics registry",
+        registry=REGISTRY)
+    # structured event journal (util/events.py): one count per recorded
+    # cluster state transition, so the ring and Prometheus agree
+    EVENTS_TOTAL = Counter(
+        "SeaweedFS_events_total",
+        "cluster state transitions recorded in the event journal",
+        ["type"], registry=REGISTRY)
+    # saturation probes (stats/saturation.py), sampled into the
+    # timeline ring so "slow" is attributable to a saturated resource
+    EVENTLOOP_LAG = Gauge(
+        "SeaweedFS_eventloop_lag_seconds",
+        "max asyncio scheduling delay observed since the last sample",
+        registry=REGISTRY)
+    EXECUTOR_WAIT = Gauge(
+        "SeaweedFS_executor_wait_seconds",
+        "queue wait of a probe task submitted to the default executor",
+        registry=REGISTRY)
+    EXECUTOR_QUEUE_DEPTH = Gauge(
+        "SeaweedFS_executor_queue_depth",
+        "tasks waiting in the default executor work queue",
+        registry=REGISTRY)
+    OPEN_FDS = Gauge(
+        "SeaweedFS_open_fds",
+        "file descriptors currently open in this process",
+        registry=REGISTRY)
+    DISK_FREE_BYTES = Gauge(
+        "SeaweedFS_disk_free_bytes",
+        "free bytes on the filesystem holding a data dir",
+        ["path"], registry=REGISTRY)
+    DISK_USED_BYTES = Gauge(
+        "SeaweedFS_disk_used_bytes",
+        "used bytes on the filesystem holding a data dir",
+        ["path"], registry=REGISTRY)
+    CACHE_BUDGET_BYTES = Gauge(
+        "SeaweedFS_cache_budget_bytes",
+        "configured byte budget per read cache (occupancy vs budget)",
+        ["cache"], registry=REGISTRY)
+    # SLO burn-rate engine (stats/slo.py)
+    SLO_STATUS = Gauge(
+        "SeaweedFS_slo_status",
+        "health verdict per objective: 0=ok 1=warn 2=page",
+        ["objective"], registry=REGISTRY)
+    SLO_BURN_RATE = Gauge(
+        "SeaweedFS_slo_burn_rate",
+        "error-budget burn rate per objective and evaluation window",
+        ["objective", "window"], registry=REGISTRY)
+
+    from .. import __version__
+    BUILD_INFO.labels(__version__, platform.python_version()).set(1)
+    PROCESS_START_TIME.set(time.time())
 
     def metrics_text() -> bytes:
         return generate_latest(REGISTRY)
 else:  # pragma: no cover
     def metrics_text() -> bytes:
         return b"# prometheus_client unavailable\n"
+
+
+# Gauges where summing across workers fabricates a value no process
+# ever observed: every worker samples the SAME filesystem (sum doubles
+# free/used space), scheduling-delay probes are per-loop latencies (the
+# host's honest number is the WORST worker), build_info is a constant 1
+# per process, and process_start_time is a unix timestamp (dashboards
+# compute `time() - start`; max = the most recent birth, so ANY worker
+# respawn moves it — exactly the restart signal the gauge exists for).
+# The SLO verdict gauges are per-process VERDICTS, not quantities: two
+# workers both at warn (1+1) must merge to warn=1, not page=2, and two
+# sub-threshold burn rates must not sum past the page threshold — the
+# host's honest health is the WORST worker's, i.e. max.
+# Everything else — open fds, queue depth, cache bytes — is a genuinely
+# per-process resource and sums like counters do. Shared by this
+# /metrics merge and the /debug/timeline whole-host merge.
+NON_ADDITIVE_GAUGE_PREFIXES = (
+    "SeaweedFS_disk_",
+    "SeaweedFS_eventloop_lag_seconds",
+    "SeaweedFS_executor_wait_seconds",
+    "SeaweedFS_build_info",
+    "SeaweedFS_process_start_time_seconds",
+    "SeaweedFS_slo_",
+)
+_NON_ADDITIVE_B = tuple(p.encode() for p in NON_ADDITIVE_GAUGE_PREFIXES)
 
 
 def merge_metrics_texts(texts: "list[bytes]") -> bytes:
@@ -111,7 +198,8 @@ def merge_metrics_texts(texts: "list[bytes]") -> bytes:
 
     Counters, gauges, and histogram buckets are summed per
     (name, labels); `*_created` timestamps take the min (first birth);
-    HELP/TYPE comments are kept from their first appearance.
+    the non-additive gauges above take the max; HELP/TYPE comments are
+    kept from their first appearance.
 
     Integral sums are emitted WITHOUT a trailing `.0` and never in
     exponent notation: `repr(float)` rendered a summed counter of 123
@@ -142,6 +230,8 @@ def merge_metrics_texts(texts: "list[bytes]") -> bytes:
                 sums[key] = val
             elif key.split(b"{", 1)[0].endswith(b"_created"):
                 sums[key] = min(sums[key], val)
+            elif key.startswith(_NON_ADDITIVE_B):
+                sums[key] = max(sums[key], val)
             else:
                 sums[key] += val
     out = []

@@ -17,7 +17,7 @@ from ..ec import gf
 from ..ec.ec_volume import EcVolume, NotFoundError as EcNotFound
 from ..ec.locate import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE
 from ..pb import messages as pb
-from ..util import failpoints, tracing
+from ..util import events, failpoints, tracing
 from . import types as t
 from .needle import Needle
 from .super_block import ReplicaPlacement
@@ -78,6 +78,13 @@ class _VolumeAppender:
                 self.appended += len(batch)
                 if len(batch) > self.max_batch:
                     self.max_batch = len(batch)
+                    if v.fsync and len(batch) > 1:
+                        # deepest-yet batch sharing ONE durable fsync
+                        # point: rate-bounded by construction (only
+                        # new records journal), the flight recorder's
+                        # view of group-commit behavior under load
+                        events.record("fsync_upgrade", vid=v.vid,
+                                      batch=len(batch))
             except BaseException as e:  # noqa: BLE001 — every waiter
                 # must be released; append_needles only raises on bugs
                 for it in batch:
@@ -296,6 +303,8 @@ class Store:
                 needle_map_kind=self.index_type))
             self.volumes[vid] = v
             self.new_volumes.append(self._volume_message(v))
+            events.record("volume_mount", vid=vid, kind="allocate",
+                          collection=collection)
             return v
 
     def delete_volume(self, vid: int, collection: str = "") -> None:
@@ -306,6 +315,7 @@ class Store:
                 msg = self._volume_message(v)
                 v.destroy()
                 self.deleted_volumes.append(msg)
+                events.record("volume_unmount", vid=vid, kind="delete")
                 return
             # not mounted: still destroy the on-disk files — an unmount
             # followed by delete must not leave .dat/.idx behind to
@@ -355,6 +365,8 @@ class Store:
                                          needle_map_kind=self.index_type))
                     self.volumes[vid] = v
                     self.new_volumes.append(self._volume_message(v))
+                    events.record("volume_mount", vid=vid, kind="mount",
+                                  collection=collection)
                     return
             raise VolumeError(f"volume {vid} not on disk")
 
@@ -365,6 +377,7 @@ class Store:
             if v is not None:
                 self.deleted_volumes.append(self._volume_message(v))
                 v.close()
+                events.record("volume_unmount", vid=vid, kind="unmount")
 
     # ---- data plane ----
 
@@ -590,6 +603,7 @@ class Store:
             raise NotFound(f"volume {vid} not found")
         vacuum.commit_compact(v)
         self.drop_cached_volume(vid)
+        events.record("volume_vacuum", vid=vid)
 
     def has_volume(self, vid: int) -> bool:
         return vid in self.volumes or vid in self.ec_volumes
@@ -627,6 +641,8 @@ class Store:
                         pb.VolumeEcShardInformationMessage(
                             id=vid, collection=collection,
                             ec_index_bits=bits))
+                    events.record("ec_mount", vid=vid,
+                                  shards=sorted(ev.shards))
                     return sorted(ev.shards)
             raise VolumeError(f"no .ecx found for ec volume {vid}")
 
@@ -652,6 +668,7 @@ class Store:
             self.deleted_ec_shards.append(
                 pb.VolumeEcShardInformationMessage(
                     id=vid, collection=ev.collection, ec_index_bits=bits))
+            events.record("ec_unmount", vid=vid, shards=removed)
             if not ev.shards:
                 ev.close()
                 del self.ec_volumes[vid]

@@ -95,6 +95,13 @@ class CacheCounters:
         if p:
             p[4].set(nbytes)
 
+    def set_budget(self, nbytes: int) -> None:
+        """Export the configured byte budget so occupancy-vs-budget is
+        one division on any scrape/timeline window (saturation.py)."""
+        from ..stats import metrics
+        if metrics.HAVE_PROMETHEUS:
+            metrics.CACHE_BUDGET_BYTES.labels(self.name).set(nbytes)
+
     def to_dict(self) -> dict:
         total = self.hits + self.misses
         return {"hits": self.hits, "misses": self.misses,
@@ -115,6 +122,7 @@ class LruByteCache:
                  counters: CacheCounters | None = None):
         self.budget = max(0, int(budget))
         self.counters = counters or CacheCounters(name)
+        self.counters.set_budget(self.budget)
         self._lock = threading.Lock()
         self._map: "OrderedDict[object, tuple[object, int]]" = OrderedDict()
         self._used = 0

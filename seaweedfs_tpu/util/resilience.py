@@ -79,7 +79,8 @@ class RetryPolicy:
                  per_attempt_timeout: float | None = None,
                  budget: RetryBudget | None = None,
                  rng: random.Random | None = None,
-                 clock=time.monotonic, sleep=None):
+                 clock=time.monotonic, sleep=None, name: str = ""):
+        self.name = name            # journal attribution (events.py)
         self.max_attempts = max_attempts
         self.base_delay = base_delay
         self.max_delay = max_delay
@@ -102,7 +103,14 @@ class RetryPolicy:
             if attempt:
                 if self.budget is not None and \
                         not self.budget.allow_retry():
-                    return          # budget exhausted: fail fast
+                    # budget exhausted: fail fast — and journal it,
+                    # because a brown-out's retry storm hitting the
+                    # ceiling is exactly the transition an operator
+                    # reading /debug/health evidence needs to see
+                    from . import events
+                    events.record("retry_budget_exhausted",
+                                  name=self.name, attempt=attempt)
+                    return
                 delay = self.backoff(attempt)
                 if self._clock() + delay >= deadline:
                     return
@@ -120,11 +128,13 @@ class CircuitBreaker:
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
     def __init__(self, threshold: int = 5, reset_timeout: float = 10.0,
-                 half_open_max: int = 1, clock=time.monotonic):
+                 half_open_max: int = 1, clock=time.monotonic,
+                 name: str = ""):
         self.threshold = threshold
         self.reset_timeout = reset_timeout
         self.half_open_max = half_open_max
         self._clock = clock
+        self.name = name            # upstream key, journal attribution
         self.state = self.CLOSED
         self.failures = 0
         self.opened_at = 0.0
@@ -158,6 +168,10 @@ class CircuitBreaker:
         # closes from ANY state: the read path tries demoted (open)
         # upstreams last instead of skipping them, and a success there
         # is direct evidence of health
+        if self.state != self.CLOSED:
+            from . import events
+            events.record("breaker_close", upstream=self.name,
+                          was=self.state)
         self.state = self.CLOSED
         self.failures = 0
         self.probes = 0
@@ -174,6 +188,9 @@ class CircuitBreaker:
             self.state = self.OPEN
             self.opened_at = self._clock()
             self.open_count += 1
+            from . import events
+            events.record("breaker_open", upstream=self.name,
+                          failures=self.failures)
 
     def to_dict(self) -> dict:
         return {"state": self.state, "failures": self.failures,
@@ -199,7 +216,7 @@ class BreakerRegistry:
                 self._breakers.clear()
             b = self._breakers[upstream] = CircuitBreaker(
                 self.threshold, self.reset_timeout, self.half_open_max,
-                clock=self._clock)
+                clock=self._clock, name=upstream)
         return b
 
     def to_dict(self) -> dict:

@@ -425,12 +425,25 @@ def requests_dict() -> dict:
     return {"inflight": len(rows), "requests": rows}
 
 
+MAX_QUERY_COUNT = 1000   # ?n=/?slowest= ceiling: the ring itself is
+#                          bounded, but a huge count would still be
+#                          interpolated into sibling fan-out URLs and
+#                          serialized into one giant JSON body
+
+
+def clamp_count(n: int, cap: int = MAX_QUERY_COUNT) -> int:
+    """Clamp a user-supplied result count into [0, cap]: ?n=-5 must be
+    an explicit empty slice (never a from-the-end slice) and ?n=10**9
+    must not balloon the payload."""
+    return max(0, min(int(n), cap))
+
+
 def traces_query(query) -> dict:
     """traces_dict driven by a ?n=&slowest= query mapping — the one
     parser shared by every server's /debug/traces handler (raises
-    ValueError on malformed counts)."""
-    return traces_dict(recent=int(query.get("n", 20)),
-                       slowest=int(query.get("slowest", 10)))
+    ValueError on malformed counts; negative/huge counts clamped)."""
+    return traces_dict(recent=clamp_count(query.get("n", 20)),
+                       slowest=clamp_count(query.get("slowest", 10)))
 
 
 def debug_handlers():

@@ -1,0 +1,274 @@
+"""SLO burn-rate engine (stats/slo.py): spec grammar, exact
+over-threshold fractions from histogram deltas, multi-window page/warn
+verdicts, the min-count guard, and evidence correlation (violating
+slice + journal events + worst trace)."""
+
+from __future__ import annotations
+
+import pytest
+
+from seaweedfs_tpu.stats import slo
+from seaweedfs_tpu.util import events, tracing
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    events.reset()
+    tracing.init(sample=1.0)
+    tracing.reset()
+    yield
+    events.reset()
+    tracing.reset()
+    slo.init([])
+
+
+# ---------------------------------------------------------------------------
+# spec grammar
+
+
+def test_spec_parses():
+    s = slo.SloSpec("volume.read:p99<50ms@99.9")
+    assert (s.tier, s.op) == ("volume", "read")
+    assert s.quantile == pytest.approx(0.99)
+    assert s.threshold_s == pytest.approx(0.05)
+    assert s.objective == pytest.approx(0.999)
+    assert s.budget == pytest.approx(0.001)
+    s2 = slo.SloSpec("filer.stream:p95<2s@99")
+    assert s2.threshold_s == 2.0 and s2.objective == pytest.approx(0.99)
+
+
+@pytest.mark.parametrize("bad", [
+    "volume.read",                  # no objective
+    "volume.read:p99<50ms",         # no @
+    "volume:p99<50ms@99",           # no op
+    "volume.read:q99<50ms@99",      # not pNN
+    "volume.read:p99<50m@99",       # bad unit
+    "volume.read:p99<50ms@0",       # objective out of range
+    "volume.read:p99<50ms@100",
+    "",
+])
+def test_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        slo.SloSpec(bad)
+
+
+def test_init_raises_on_bad_spec():
+    with pytest.raises(ValueError):
+        slo.init(["volume.read:p99<50ms@99", "garbage"])
+
+
+def test_cli_refuses_slo_with_recorder_disabled(tmp_path):
+    # -slo with -timeline.interval 0 would guard nothing: no window is
+    # ever snapped, tick() never runs, /debug/health stays ok forever
+    # — the same silent-pass hazard as a typo'd spec, refused the same
+    # way (regression: the daemon used to start cleanly)
+    from seaweedfs_tpu import cli
+    from seaweedfs_tpu.stats import timeline
+    with pytest.raises(SystemExit, match="flight recorder"):
+        cli.main(["volume", "-port", "0", "-dir", str(tmp_path),
+                  "-slo", "volume.read:p99<50ms@99",
+                  "-timeline.interval", "0"])
+    timeline.init()                      # restore process defaults
+
+
+# ---------------------------------------------------------------------------
+# histogram math
+
+
+def test_frac_over_interpolates():
+    buckets = {"0.01": 90.0, "0.1": 100.0, "+Inf": 100.0}
+    # threshold on a bucket edge: exact
+    assert slo._frac_over(buckets, 0.01, 100.0) == pytest.approx(0.10)
+    # inside the (0.01, 0.1] bucket: linear
+    assert slo._frac_over(buckets, 0.055, 100.0) == pytest.approx(0.05)
+    # +Inf mass always counts as over
+    assert slo._frac_over({"0.01": 0.0, "+Inf": 10.0}, 0.05, 10.0) \
+        == pytest.approx(1.0)
+    assert slo._frac_over({}, 0.05, 0.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# verdicts
+
+
+BASE = ('SeaweedFS_request_duration_seconds'
+        '{op="read",status="ok",tier="volume"}')
+
+
+def _win(wall_ms: float, under: float, over: float) -> dict:
+    total = under + over
+    return {"wall_ms": wall_ms, "dt_s": 1.0, "rates": {}, "gauges": {},
+            "hist": {BASE: {"buckets": {"0.025": under, "+Inf": total},
+                            "sum": 0.0, "count": total}}}
+
+
+def _engine():
+    return slo.SloEngine([slo.SloSpec("volume.read:p99<50ms@99")])
+
+
+def test_ok_when_healthy():
+    now = 1_000_000.0
+    wins = [_win(now - i * 1000, 100, 0) for i in range(30)]
+    out = _engine().evaluate(wins, now_ms=now)
+    assert out["status"] == "ok"
+    obj = out["objectives"][0]
+    assert obj["status"] == "ok" and obj["fast"]["burn"] == 0.0
+    assert "evidence" not in obj
+
+
+def test_page_with_evidence():
+    now = 1_000_000.0
+    events.record("breaker_open", upstream="w1")
+    ev_rows = events.events_dict()["events"]
+    for r in ev_rows:
+        r["wall_ms"] = now - 5_000          # inside the fast window
+    # every request over threshold: burn = 1.0/0.01 = 100 >> 14.4 in
+    # both windows
+    wins = [_win(now - i * 1000, 0, 10) for i in range(30)]
+    out = _engine().evaluate(wins, events=ev_rows, now_ms=now)
+    assert out["status"] == "page"
+    obj = out["objectives"][0]
+    assert obj["fast"]["burn"] >= slo.PAGE_BURN
+    assert obj["slow"]["burn"] >= slo.PAGE_BURN
+    ev = obj["evidence"]
+    assert ev["violating_windows"], "violating slice must be present"
+    assert all(w["frac_over"] > 0 for w in ev["violating_windows"])
+    assert any(e["type"] == "breaker_open" for e in ev["events"])
+    assert ev["window"]["from_ms"] < ev["window"]["to_ms"]
+
+
+def test_evidence_spans_the_whole_burn_episode():
+    # a slow-burn page can land minutes after the breaker trip that
+    # explains it (regression: evidence was clipped to the fast 60s
+    # window, so a soak that paged late correlated ZERO events)
+    now = 1_000_000.0
+    events.record("breaker_open", upstream="w1")
+    ev_rows = events.events_dict()["events"]
+    for r in ev_rows:
+        r["wall_ms"] = now - 200_000        # 200s ago: damage onset
+    # violation running for 240s: the earliest violating windows sit
+    # far outside the fast horizon but inside the slow one
+    wins = [_win(now - i * 1000, 0, 10) for i in range(240)]
+    out = _engine().evaluate(wins, events=ev_rows, now_ms=now)
+    assert out["status"] == "page"
+    ev = out["objectives"][0]["evidence"]
+    assert ev["violating_total"] == 240
+    assert len(ev["violating_windows"]) == 200      # capped, newest
+    assert any(e["type"] == "breaker_open" for e in ev["events"])
+    # the correlation window opens at the start of the damage, not 60s
+    # before the page
+    assert ev["window"]["from_ms"] <= now - 200_000
+
+
+def test_warn_between_burn_thresholds():
+    now = 1_000_000.0
+    # 8% violating, p99 allows 1% free, 1% budget -> burn 7: warn
+    # (>=6) but not page
+    wins = [_win(now - i * 1000, 92, 8) for i in range(30)]
+    out = _engine().evaluate(wins, now_ms=now)
+    assert out["objectives"][0]["status"] == "warn"
+    assert out["status"] == "warn"
+
+
+def test_quantile_is_honored():
+    # regression: the pQQ in a spec used to be parsed and echoed but
+    # never evaluated, so p50 and p99 behaved identically.  p99<25ms
+    # permits 1% of requests over the threshold; p50<25ms permits 50%.
+    now = 1_000_000.0
+    wins = [_win(now - i * 1000, 70, 30) for i in range(30)]
+    strict = slo.SloEngine([slo.SloSpec("volume.read:p99<50ms@99")])
+    lax = slo.SloEngine([slo.SloSpec("volume.read:p50<50ms@99")])
+    s = strict.evaluate(wins, now_ms=now)["objectives"][0]
+    l = lax.evaluate(wins, now_ms=now)["objectives"][0]
+    # 30% over: p99 burns (0.30-0.01)/0.01=29 -> page; p50 has 20%
+    # headroom left -> burn 0, ok
+    assert s["status"] == "page" and s["fast"]["burn"] >= slo.PAGE_BURN
+    assert l["status"] == "ok" and l["fast"]["burn"] == 0.0
+
+
+def test_merged_evaluate_does_not_flap_transition_state():
+    # regression: /debug/health evaluates whole-host MERGED windows
+    # against the same engine the local tick() uses; it must not touch
+    # _last_status or every poll would log phantom ok->page->ok flaps
+    # whenever local and merged verdicts disagree
+    now = 1_000_000.0
+    eng = _engine()
+    bad = [_win(now - i * 1000, 0, 10) for i in range(30)]
+    good = [_win(now - i * 1000, 100, 0) for i in range(30)]
+    eng.evaluate(good, now_ms=now, update_metrics=True)
+    assert eng._last_status.get("volume.read:p99<50ms@99", "ok") == "ok"
+    # a merged-view page (the handler path: update_metrics=False)
+    out = eng.evaluate(bad, now_ms=now)
+    assert out["status"] == "page"
+    assert eng._last_status.get("volume.read:p99<50ms@99", "ok") == "ok"
+    # the canonical tick path still records it
+    eng.evaluate(bad, now_ms=now, update_metrics=True)
+    assert eng._last_status["volume.read:p99<50ms@99"] == "page"
+
+
+def test_min_count_guard():
+    now = 1_000_000.0
+    # one catastrophically slow request on an idle daemon: no page
+    wins = [_win(now - 1000, 0, 1)]
+    out = _engine().evaluate(wins, now_ms=now)
+    assert out["status"] == "ok"
+
+
+def test_slow_window_guards_against_blips():
+    now = 1_000_000.0
+    # a fully-violating fast window inside an otherwise-healthy 10
+    # minutes: fast burns hot but the slow window stays under the page
+    # threshold -> no page (one blip is not an incident)
+    wins = [_win(now - i * 1000, 0, 20) for i in range(60)]
+    wins += [_win(now - (i + 60) * 1000, 2000, 0) for i in range(540)]
+    eng = _engine()
+    out = eng.evaluate(wins, now_ms=now)
+    assert out["objectives"][0]["fast"]["burn"] >= slo.PAGE_BURN
+    assert out["objectives"][0]["status"] != "page"
+
+
+def test_worst_trace_in_evidence():
+    now_ms = None
+    with tracing.start_root("volume", "read") as sp:
+        pass
+    import time
+    now_ms = time.time() * 1000.0
+    wins = [_win(now_ms - 1000, 0, 100)]
+    out = _engine().evaluate(wins, now_ms=now_ms)
+    worst = out["objectives"][0]["evidence"].get("worst_trace")
+    assert worst is not None and worst["trace"] == sp.trace
+
+
+def test_health_dict_without_engine_is_stable_schema():
+    slo.init([])
+    out = slo.health_dict([])
+    assert out["status"] == "ok" and out["objectives"] == []
+    assert "now_ms" in out
+
+
+def test_engine_matches_only_its_tier_op():
+    other = ('SeaweedFS_request_duration_seconds'
+             '{op="write",status="ok",tier="volume"}')
+    now = 1_000_000.0
+    win = {"wall_ms": now - 1000, "dt_s": 1.0, "rates": {}, "gauges": {},
+           "hist": {other: {"buckets": {"+Inf": 100.0}, "sum": 0.0,
+                            "count": 100.0}}}
+    out = _engine().evaluate([win], now_ms=now)
+    assert out["objectives"][0]["fast"]["count"] == 0
+    assert out["status"] == "ok"
+
+
+def test_tick_exports_metrics():
+    from seaweedfs_tpu.stats import metrics, timeline
+    if not metrics.HAVE_PROMETHEUS:
+        pytest.skip("prometheus_client unavailable")
+    timeline.init(interval_s=1.0, ring=16)
+    timeline.reset()
+    slo.init(["volume.read:p99<50ms@99"])
+    timeline.snap()
+    metrics.REQUEST_DURATION.labels("volume", "read", "ok").observe(5.0)
+    timeline.snap()
+    slo.tick()
+    text = metrics.metrics_text().decode()
+    assert 'SeaweedFS_slo_burn_rate{' in text
+    assert 'SeaweedFS_slo_status{' in text

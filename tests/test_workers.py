@@ -428,6 +428,18 @@ def test_volume_workers_wire(tmp_path):
                   or (_ for _ in ()).throw(AssertionError("stale")),
                   tries=40)
 
+        # the respawn is journaled where /debug/events can see it: the
+        # supervisor serves no HTTP, so the respawned worker records
+        # the event in its OWN ring at boot (regression: it used to
+        # land only in the supervisor's unserved journal)
+        def respawn_journaled():
+            body = json.loads(_get(f"{shared}/debug/events?n=200"))
+            row = [e for e in body["events"]
+                   if e["type"] == "worker_respawn"][0]
+            assert row["index"] == 1 and row["respawns"] >= 1
+            return True
+        _wait(respawn_journaled, tries=40)
+
 
 def test_master_workers_wire(tmp_path):
     """`master -workers 2`: assigns through the shared port stay unique

@@ -158,6 +158,32 @@ def bench_one(workers: int, n: int, size: int, conc: int,
             print(f"--- per-tier trace breakdown (workers={workers}) "
                   f"---", file=sys.stderr)
             print(trace_table.breakdown([vol_addr]), file=sys.stderr)
+            # flight-recorder pull: force one timeline window covering
+            # the run and report the health verdict (the whole-host
+            # merged /debug surfaces, same fan-out as /metrics)
+            try:
+                req = urllib.request.Request(
+                    f"http://{vol_addr}/debug/timeline?snap=1",
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    tl = json.load(r)
+                with urllib.request.urlopen(
+                        f"http://{vol_addr}/debug/health",
+                        timeout=10) as r:
+                    health = json.load(r)
+                # only report a verdict when an objective is armed
+                # (SWTPU_BENCH_VOLFLAGS="-slo ..."): the empty-engine
+                # stub says "ok" no matter what happened, and a bench
+                # row must not launder that into a health claim
+                if health.get("objectives"):
+                    row["health"] = health.get("status", "?")
+                win = (tl.get("windows") or [{}])[-1]
+                for base, q in win.get("quantiles", {}).items():
+                    if "request_duration" in base and "read" in base:
+                        row.setdefault("p99_s", {})[base] = q.get("p99")
+            except (OSError, ValueError) as e:
+                print(f"(flight recorder pull failed: {e})",
+                      file=sys.stderr)
         return row
     finally:
         for p in procs:

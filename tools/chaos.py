@@ -242,10 +242,16 @@ async def run(args) -> int:
                     "-defaultReplication", "001")
         await asyncio.sleep(2)
         for i in range(n_servers):
+            # --slo arms a real objective on every server: without one
+            # the engine is empty and /debug/health answers a
+            # structurally-ok stub no matter how much damage the
+            # failpoints do, which would make the recorder report a lie
+            slo_flags = (("-slo", "volume.read:p99<250ms@99")
+                         if args.slo else ())
             procs.spawn("volume", "-port", str(BASE_PORT + 1 + i),
                         "-dir", os.path.join(tmp, f"v{i}"),
                         "-max", "20", "-master", master,
-                        "-pulseSeconds", "1")
+                        "-pulseSeconds", "1", *slo_flags)
         wait_assign(master, "replication=001")
 
         # runtime arming over the live admin endpoint (this also IS the
@@ -312,6 +318,30 @@ async def run(args) -> int:
             print("--- per-tier trace breakdown (survivors) ---")
             print(trace_table.render(rows))
             report["trace_breakdown"] = rows
+        if args.slo:
+            # flight-recorder pull from the survivors: one forced
+            # timeline window covering the run, the merged journal, and
+            # the health verdict — the chaos report carries what the
+            # cluster SAW, not just what the driver measured
+            recorder = {}
+            for i in range(n_servers - 1):
+                addr = f"127.0.0.1:{BASE_PORT + 1 + i}"
+                try:
+                    http_json(f"http://{addr}/debug/timeline?snap=1",
+                              method="POST")
+                    h = http_json(f"http://{addr}/debug/health")
+                    ev = http_json(f"http://{addr}/debug/events?n=50")
+                    recorder[addr] = {
+                        "health": h["status"],
+                        "objectives": h.get("objectives", []),
+                        "event_types": sorted(
+                            {e["type"] for e in ev["events"]})}
+                except (OSError, ValueError, KeyError):
+                    continue
+            report["recorder"] = recorder
+            for addr, rec in recorder.items():
+                print(f"recorder {addr}: health={rec['health']} "
+                      f"events={rec['event_types']}")
         if not args.quick and not any(fired.values()):
             print("FAIL: no failpoint ever fired — the chaos run "
                   "tested nothing")
@@ -363,6 +393,10 @@ def main() -> int:
                     help="pull /debug/traces from the surviving volume "
                          "servers and print the per-tier latency "
                          "breakdown table")
+    ap.add_argument("--slo", action="store_true",
+                    help="pull the flight recorder (/debug/timeline + "
+                         "/debug/events + /debug/health) from the "
+                         "surviving volume servers into the report")
     ap.add_argument("--json", help="write the report to this path")
     ap.add_argument("--keep", action="store_true",
                     help="keep tmpdir + server logs")

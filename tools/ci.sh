@@ -44,6 +44,14 @@ if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/wire_smoke.py; then
     exit 1
 fi
 
+echo "== flight-recorder smoke (timeline + events + health, -workers 2) =="
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/recorder_smoke.py; then
+    echo "recorder smoke: FAILED (schema drift on /debug/timeline,"
+    echo "/debug/events or /debug/health — soaks and operator tooling"
+    echo "assert these shapes; see output above)"
+    exit 1
+fi
+
 echo "== ec repair-bandwidth smoke (minimal-fetch + batched rebuild) =="
 if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/bench_ec.py --smoke; then
     echo "bench_ec smoke: FAILED (repair-bandwidth regression — minimal-"

@@ -1,0 +1,176 @@
+"""~20s flight-recorder smoke for tools/ci.sh.
+
+Boots a REAL master + `-workers 2` volume fleet as CLI processes,
+writes and reads a handful of needles through the shared public port,
+forces a whole-host timeline snapshot, and then SCHEMA-CHECKS the
+three recorder surfaces:
+
+  /debug/timeline  — merged windows with rates/gauges/hist/quantiles,
+                     build_info + process_start_time gauges present
+                     (restart detection), request histograms recorded;
+  /debug/events    — merged journal rows with type/wall_ms/mono/trace,
+                     at least one volume_mount from the write path;
+  /debug/health    — ok with a configured -slo objective evaluated
+                     (fast/slow burn rows present).
+
+Any key drift in these payloads fails CI before a soak or operator
+tooling trips over it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+PORT = int(os.environ.get("SWTPU_SMOKE_PORT", "22050"))
+
+
+def wait_assign(master: str, tries: int = 60) -> None:
+    for _ in range(tries):
+        try:
+            with urllib.request.urlopen(
+                    f"http://{master}/dir/assign", timeout=3) as r:
+                if b"fid" in r.read():
+                    return
+        except OSError:
+            pass
+        time.sleep(0.5)
+    raise RuntimeError("cluster never became assignable")
+
+
+def get_json(addr: str, path: str, method: str = "GET") -> dict:
+    req = urllib.request.Request(f"http://{addr}{path}", method=method)
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return json.load(r)
+
+
+def check(cond: bool, what: str) -> None:
+    if not cond:
+        raise AssertionError(f"schema drift: {what}")
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="swtpu_rec_smoke_")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    master = f"127.0.0.1:{PORT}"
+    vol = f"127.0.0.1:{PORT + 1}"
+    procs: list[subprocess.Popen] = []
+
+    def spawn(*args: str) -> None:
+        log = open(os.path.join(tmp, f"proc{len(procs)}.log"), "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu.cli", *args],
+            stdout=log, stderr=subprocess.STDOUT, env=env, cwd=tmp))
+
+    try:
+        spawn("master", "-port", str(PORT), "-mdir",
+              os.path.join(tmp, "m"), "-pulseSeconds", "1")
+        time.sleep(1.5)
+        spawn("volume", "-port", str(PORT + 1), "-dir",
+              os.path.join(tmp, "v"), "-max", "10", "-master", master,
+              "-pulseSeconds", "1", "-workers", "2",
+              "-timeline.interval", "2",
+              "-slo", "volume.read:p99<250ms@99")
+        wait_assign(master)
+
+        # traffic across both workers' vid partitions
+        fids = []
+        for i in range(8):
+            a = get_json(master, "/dir/assign")
+            body = f"recorder-{i}-".encode() * 64
+            req = urllib.request.Request(
+                f"http://{a['url']}/{a['fid']}", data=body,
+                method="POST", headers={"X-Raw-Needle": "0"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                check(r.status in (200, 201), f"write {r.status}")
+            fids.append((a["fid"], a["url"]))
+        for fid, url in fids:
+            with urllib.request.urlopen(f"http://{vol}/{fid}",
+                                        timeout=10) as r:
+                check(r.status == 200, f"read {r.status}")
+
+        # -- /debug/timeline (forced whole-host snapshot) ---------------
+        tl = get_json(vol, "/debug/timeline?snap=1", method="POST")
+        for key in ("interval_s", "ring", "windows"):
+            check(key in tl, f"/debug/timeline missing {key!r}")
+        check(tl["windows"], "/debug/timeline has no windows")
+        win = tl["windows"][-1]
+        for key in ("wall_ms", "dt_s", "rates", "gauges", "hist",
+                    "quantiles"):
+            check(key in win, f"timeline window missing {key!r}")
+        gk = list(win["gauges"])
+        check(any(k.startswith("SeaweedFS_build_info") for k in gk),
+              "build_info gauge absent")
+        check("SeaweedFS_process_start_time_seconds" in win["gauges"],
+              "process_start_time gauge absent")
+        hists = [k for w in tl["windows"]
+                 for k in w["quantiles"]
+                 if k.startswith("SeaweedFS_request_duration_seconds")
+                 and 'tier="volume"' in k]
+        check(hists, "no volume request histograms in any window")
+        qrow = None
+        for w in tl["windows"]:
+            for k, q in w["quantiles"].items():
+                if k in hists:
+                    qrow = q
+        for key in ("p50", "p95", "p99", "count", "rate"):
+            check(key in qrow, f"quantile row missing {key!r}")
+        print(f"  timeline: {len(tl['windows'])} merged windows, "
+              f"volume read p99={qrow['p99'] * 1000:.1f}ms over "
+              f"{int(qrow['count'])} requests")
+
+        # -- /debug/events ---------------------------------------------
+        ev = get_json(vol, "/debug/events?n=200")
+        for key in ("events", "recorded"):
+            check(key in ev, f"/debug/events missing {key!r}")
+        check(ev["events"], "journal is empty after allocate traffic")
+        row = ev["events"][0]
+        for key in ("seq", "type", "wall_ms", "mono", "trace"):
+            check(key in row, f"event row missing {key!r}")
+        types = {e["type"] for e in ev["events"]}
+        check("volume_mount" in types,
+              f"no volume_mount in journal (saw {sorted(types)})")
+        check(any("worker" in e for e in ev["events"]),
+              "merged events carry no worker tags")
+        print(f"  events: {len(ev['events'])} rows "
+              f"({', '.join(sorted(types))})")
+
+        # -- /debug/health ---------------------------------------------
+        h = get_json(vol, "/debug/health")
+        for key in ("status", "objectives", "now_ms"):
+            check(key in h, f"/debug/health missing {key!r}")
+        check(h["status"] == "ok",
+              f"healthy fleet reports {h['status']!r}")
+        check(len(h["objectives"]) == 1, "configured -slo not evaluated")
+        obj = h["objectives"][0]
+        for key in ("spec", "status", "fast", "slow", "threshold_ms",
+                    "objective"):
+            check(key in obj, f"objective row missing {key!r}")
+        for key in ("horizon_s", "count", "frac_over", "burn"):
+            check(key in obj["fast"], f"burn window missing {key!r}")
+        print(f"  health: {h['status']} ({obj['spec']}, fast burn "
+              f"{obj['fast']['burn']})")
+        print("recorder smoke: OK")
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        time.sleep(1)   # workers notice the dead supervisor and exit
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,7 @@ def rows_from_payloads(payloads: list[dict]) -> list[dict]:
         rows.append({
             "tier": tier, "op": op, "spans": len(vals),
             "p50_ms": round(pct(50), 2), "p95_ms": round(pct(95), 2),
+            "p99_ms": round(pct(99), 2),
             "avg_self_ms": round(sum(selfs) / len(selfs), 3),
             "total_self_ms": round(sum(selfs), 1),
         })
@@ -72,7 +73,7 @@ def rows_from_payloads(payloads: list[dict]) -> list[dict]:
 def render(rows: list[dict]) -> str:
     if not rows:
         return "(no traced spans — is -trace.sample > 0?)"
-    cols = ["tier", "op", "spans", "p50_ms", "p95_ms",
+    cols = ["tier", "op", "spans", "p50_ms", "p95_ms", "p99_ms",
             "avg_self_ms", "total_self_ms"]
     table = [cols] + [[str(r[c]) for c in cols] for r in rows]
     widths = [max(len(line[i]) for line in table)
