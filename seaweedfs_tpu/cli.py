@@ -538,7 +538,8 @@ async def _run_worker_supervisor(args, kind: str) -> None:
     if args.port == 0:
         raise SystemExit(f"{kind} -workers needs an explicit -port "
                          f"(the workers share it via SO_REUSEPORT)")
-    state_dir = fresh_state_dir(_worker_state_dir(args, kind))
+    state_dir = await tracing.run_in_executor(
+        fresh_state_dir, _worker_state_dir(args, kind))
     env = dict(os.environ)
     env[WORKER_TOKEN_ENV] = env.get(WORKER_TOKEN_ENV) \
         or secrets.token_hex(16)
@@ -618,27 +619,30 @@ async def _run_master(args) -> None:
     if args.workerIndex == 0:
         _watch_parent()
         worker_ctx = _make_worker_ctx(args, "master")
-    toml_cfg = _load_master_toml()
-    m = MasterServer(ip=args.ip, port=args.port,
-                     volume_size_limit_mb=args.volumeSizeLimitMB,
-                     default_replication=args.defaultReplication,
-                     pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
-                     peers=[p.strip() for p in args.peers.split(",")
-                            if p.strip()],
-                     # explicit CLI flag beats discovered config (None =
-                     # flag not given, so even an explicit `-sequencer
-                     # memory` overrides a master.toml sequencer)
-                     sequencer=(args.sequencer if args.sequencer is not None
-                                else toml_cfg.get("sequencer", "memory")),
-                     meta_dir=args.mdir,
-                     garbage_threshold=args.garbageThreshold,
-                     maintenance_interval_s=args.maintenanceIntervalS,
-                     admin_scripts=toml_cfg.get("admin_scripts"),
-                     admin_scripts_interval_s=toml_cfg.get(
-                         "admin_scripts_interval_s", 17 * 60.0),
-                     white_list=parse_white_list(args.whiteList),
-                     volume_preallocate=args.volumePreallocate,
-                     worker_ctx=worker_ctx)
+    toml_cfg = await tracing.run_in_executor(_load_master_toml)
+    # ctor makedirs -mdir; keep daemon construction off the loop —
+    # under -workers respawn this loop is already serving
+    m = await tracing.run_in_executor(lambda: MasterServer(
+        ip=args.ip, port=args.port,
+        volume_size_limit_mb=args.volumeSizeLimitMB,
+        default_replication=args.defaultReplication,
+        pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
+        peers=[p.strip() for p in args.peers.split(",")
+               if p.strip()],
+        # explicit CLI flag beats discovered config (None =
+        # flag not given, so even an explicit `-sequencer
+        # memory` overrides a master.toml sequencer)
+        sequencer=(args.sequencer if args.sequencer is not None
+                   else toml_cfg.get("sequencer", "memory")),
+        meta_dir=args.mdir,
+        garbage_threshold=args.garbageThreshold,
+        maintenance_interval_s=args.maintenanceIntervalS,
+        admin_scripts=toml_cfg.get("admin_scripts"),
+        admin_scripts_interval_s=toml_cfg.get(
+            "admin_scripts_interval_s", 17 * 60.0),
+        white_list=parse_white_list(args.whiteList),
+        volume_preallocate=args.volumePreallocate,
+        worker_ctx=worker_ctx))
     await m.start()
     push_task = None
     if args.metricsGateway:
@@ -682,15 +686,18 @@ async def _run_volume(args) -> None:
     if tier_cfg:
         from .storage.backend import load_backends
         load_backends(tier_cfg)
-    store = Store(dirs, max_volume_counts=maxes,
-                  compaction_bytes_per_second=args.compactionMBps
-                  * 1024 * 1024,
-                  index_type=args.index,
-                  partition=(None if worker_ctx is None else
-                             (worker_ctx.index, worker_ctx.total)),
-                  needle_cache_bytes=args.cache_mem * 1024 * 1024,
-                  group_commit_window=args.groupcommit_ms / 1000.0,
-                  fsync=args.fsync)
+    # Store's ctor makedirs + scans every volume file — a worker
+    # respawned into a live fleet must not stall its fresh loop
+    store = await tracing.run_in_executor(lambda: Store(
+        dirs, max_volume_counts=maxes,
+        compaction_bytes_per_second=args.compactionMBps
+        * 1024 * 1024,
+        index_type=args.index,
+        partition=(None if worker_ctx is None else
+                   (worker_ctx.index, worker_ctx.total)),
+        needle_cache_bytes=args.cache_mem * 1024 * 1024,
+        group_commit_window=args.groupcommit_ms / 1000.0,
+        fsync=args.fsync))
     vs = VolumeServer(store, args.master, ip=args.ip, port=args.port,
                       data_center=args.dataCenter, rack=args.rack,
                       pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
@@ -731,9 +738,10 @@ async def _run_filer(args) -> None:
     filer = Filer(args.store, **kwargs)
     if args.notify:
         from .notification.queues import attach_to_filer
-        attach_to_filer(filer, _make_queue(args.notify))
+        attach_to_filer(filer, await tracing.run_in_executor(
+            _make_queue, args.notify))
     else:
-        _attach_discovered_queue(filer)
+        await tracing.run_in_executor(_attach_discovered_queue, filer)
     fs = FilerServer(filer, args.master,
                      ip=args.ip, port=args.port,
                      chunk_size=args.chunkSizeMB * 1024 * 1024,
@@ -834,7 +842,9 @@ async def _run_filer_copy(args) -> None:
     for src in sources:
         if os.path.isdir(src):
             base = os.path.basename(os.path.abspath(src))
-            for full in _walk_upload_files(src, args.include):
+            walked = await tracing.run_in_executor(
+                _walk_upload_files, src, args.include)
+            for full in walked:
                 rel = os.path.join(base, os.path.relpath(full, src))
                 jobs.append((full, rel))
         elif os.path.isfile(src):
@@ -897,7 +907,8 @@ async def _run_filer_replicate(args) -> None:
     from .replication.runner import replicate_from_queue
     from .replication.source import FilerSource
     # flags win; replication.toml [replication] fills whatever is absent
-    found = _find_config_toml("replication")
+    found = await tracing.run_in_executor(
+        _find_config_toml, "replication")
     cfg = found[1].get("replication", {}) if found else {}
     notify = args.notify or cfg.get("notify", "")
     source_master = args.sourceMaster or cfg.get("sourceMaster", "")
@@ -913,7 +924,7 @@ async def _run_filer_replicate(args) -> None:
         raise SystemExit(
             f"filer.replicate needs {', '.join(missing)} (flags or "
             f"replication.toml [replication] keys)")
-    queue = _make_subscription(notify)
+    queue = await tracing.run_in_executor(_make_subscription, notify)
     sink = _make_sink(sink_spec, sink_dir)
     async with FilerSource(source_master, source_dir) as src:
         await sink.start()
@@ -937,7 +948,7 @@ async def _run_s3(args) -> None:
     identities = ({args.accessKey: args.secretKey}
                   if args.accessKey else None)
     filer = Filer(args.store, **kwargs)
-    _attach_discovered_queue(filer)
+    await tracing.run_in_executor(_attach_discovered_queue, filer)
     s3 = S3Gateway(filer, args.master,
                    ip=args.ip, port=args.port, identities=identities,
                    domain_name=args.domainName,
@@ -958,14 +969,16 @@ async def _run_webdav(args) -> None:
     from .server.webdav_server import WebDavServer
     kwargs = _store_kwargs(args.store, args.dbPath)
     filer = Filer(args.store, **kwargs)
-    _attach_discovered_queue(filer)
-    wd = WebDavServer(filer, args.master,
-                      ip=args.ip, port=args.port,
-                      collection=args.collection,
-                      replication=args.replication,
-                      chunk_size=args.chunkSizeMB * 1024 * 1024,
-                      cache_mem_bytes=args.cache_mem * 1024 * 1024,
-                      cache_dir=args.cache_dir)
+    await tracing.run_in_executor(_attach_discovered_queue, filer)
+    # ctor builds the disk chunk-cache tier (makedirs)
+    wd = await tracing.run_in_executor(lambda: WebDavServer(
+        filer, args.master,
+        ip=args.ip, port=args.port,
+        collection=args.collection,
+        replication=args.replication,
+        chunk_size=args.chunkSizeMB * 1024 * 1024,
+        cache_mem_bytes=args.cache_mem * 1024 * 1024,
+        cache_dir=args.cache_dir))
     await wd.start()
     rec = _start_recorder()
     print(f"webdav listening on {wd.url} (store={args.store})")
@@ -985,10 +998,12 @@ async def _run_server(args) -> None:
     from .server.volume_server import VolumeServer
     from .storage.store import Store
 
-    m = MasterServer(ip=args.ip, port=args.masterPort, jwt_key=args.jwtKey)
+    m = await tracing.run_in_executor(lambda: MasterServer(
+        ip=args.ip, port=args.masterPort, jwt_key=args.jwtKey))
     await m.start()
     # combined mode gets the standalone daemons' default cache budgets
-    store = Store([args.dir], needle_cache_bytes=32 << 20)
+    store = await tracing.run_in_executor(
+        lambda: Store([args.dir], needle_cache_bytes=32 << 20))
     vs = VolumeServer(store, m.url, ip=args.ip, port=args.volumePort,
                       jwt_key=args.jwtKey)
     await vs.start()
@@ -999,7 +1014,8 @@ async def _run_server(args) -> None:
     if args.filer or args.s3:
         combined_filer = Filer("sqlite",
                                path=os.path.join(args.dir, "filer.db"))
-        _attach_discovered_queue(combined_filer)
+        await tracing.run_in_executor(
+            _attach_discovered_queue, combined_filer)
         filer_srv = FilerServer(
             combined_filer, m.url, ip=args.ip, port=args.filerPort,
             cache_mem_bytes=64 << 20)
@@ -1052,7 +1068,8 @@ async def _run_upload(args) -> None:
     max_mb = getattr(args, "maxMB", 0) or 0
     files = list(args.files)
     if args.updir:
-        files.extend(_walk_upload_files(args.updir, args.include))
+        files.extend(await tracing.run_in_executor(
+            _walk_upload_files, args.updir, args.include))
     if not files:
         raise SystemExit("upload: no input files (pass paths or -dir)")
     async with WeedClient(args.master) as c:
@@ -1398,10 +1415,12 @@ async def _run_backup(args) -> None:
         from .storage import types as t
         from .storage.super_block import ReplicaPlacement
         collection = args.collection or st.get("collection", "")
-        v = Volume(args.dir, collection, args.volumeId,
-                   replica_placement=ReplicaPlacement.parse(
-                       st.get("replication", "000")),
-                   ttl=t.TTL.parse(st.get("ttl", "")))
+        # Volume's ctor replays .idx/.dat metadata from disk
+        v = await tracing.run_in_executor(lambda: Volume(
+            args.dir, collection, args.volumeId,
+            replica_placement=ReplicaPlacement.parse(
+                st.get("replication", "000")),
+            ttl=t.TTL.parse(st.get("ttl", ""))))
         need_full = (
             v.super_block.compaction_revision
             != st["compaction_revision"]
@@ -1449,10 +1468,12 @@ async def _run_backup(args) -> None:
             # truncates to a consistent state; the reverse order is fatal
             for tmp, final in reversed(tmps):
                 await tracing.run_in_executor(os.replace, tmp, final)
-            v = Volume(args.dir, collection, args.volumeId,
-                       create_if_missing=False)
+            v = await tracing.run_in_executor(lambda: Volume(
+                args.dir, collection, args.volumeId,
+                create_if_missing=False))
+            size = await tracing.run_in_executor(v.data_size)
             print(f"full copy of volume {args.volumeId}: "
-                  f"{v.data_size()} bytes")
+                  f"{size} bytes")
         else:
             since = v.last_append_at_ns
             applied = 0
@@ -1464,10 +1485,18 @@ async def _run_backup(args) -> None:
                 if resp.status != 200:
                     print(f"tail from {args.server}: http {resp.status}")
                     sys.exit(1)
-                async for chunk in resp.content.iter_chunked(1 << 20):
-                    for n, is_delete in dec.feed(chunk):
+                def _apply_batch(records):
+                    for n, is_delete in records:
                         vb.apply_needle(v, n, is_delete)
-                        applied += 1
+
+                async for chunk in resp.content.iter_chunked(1 << 20):
+                    batch = list(dec.feed(chunk))
+                    if batch:
+                        # one executor hop per decoded chunk, not per
+                        # record — a multi-million-record catch-up would
+                        # otherwise pay submit/wakeup latency every needle
+                        await tracing.run_in_executor(_apply_batch, batch)
+                        applied += len(batch)
             print(f"applied {applied} records to volume {args.volumeId} "
                   f"(since_ns={since})")
         v.close()

@@ -34,7 +34,7 @@ import time
 
 import aiohttp
 
-from ..util import glog
+from ..util import glog, tracing
 
 # compact the log once it outgrows this many entries (each entry is one
 # volume-id bump; the reference's raft snapshots on a size threshold too)
@@ -106,6 +106,10 @@ class Election:
         self.adopt_max_volume_id = lambda v: None
         self._http: aiohttp.ClientSession | None = None
         self._task: asyncio.Task | None = None
+        # deferred-durability machinery: sync mutators mark, async
+        # call sites flush before the state is acted on
+        self._dirty = False
+        self._flush_lock = asyncio.Lock()
 
     @property
     def is_leader(self) -> bool:
@@ -128,18 +132,50 @@ class Election:
             return self.entries[pos]["term"]
         return None
 
-    def _persist(self) -> None:
-        """Atomically checkpoint (term, votedFor, snapshot, log). Must
-        complete before the change is acted on (raft durability rule)."""
-        if not self.state_path:
-            return
+    def _mark_dirty(self) -> None:
+        """Record that (term, votedFor, snapshot, log) changed. The
+        change becomes durable at the next ``flush()`` — and every RPC
+        reply / vote solicitation / replication round flushes BEFORE
+        acting on the state (raft durability rule), so the guarantee
+        is unchanged from the old write-inline ``_persist``; only the
+        fsync moved off the event loop."""
+        self._dirty = True
+
+    def _state_payload(self) -> str:
+        return json.dumps({"term": self.term,
+                           "voted_for": self.voted_for,
+                           "snapshot": self.snap,
+                           "entries": self.entries})
+
+    def _write_state(self, payload: str) -> None:
+        """Atomic checkpoint write (tmp + fsync + rename); runs on the
+        executor so a slow disk never stalls the loop serving every
+        master request."""
         tmp = self.state_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"term": self.term, "voted_for": self.voted_for,
-                       "snapshot": self.snap, "entries": self.entries}, f)
+            f.write(payload)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.state_path)
+
+    async def flush(self) -> None:
+        """Make every marked state change durable. Serialization
+        happens on the loop under the flush lock (so the snapshot is
+        internally consistent), the write+fsync on the executor. A
+        failed write re-marks dirty and re-raises — the caller's RPC
+        reply must not leave the node claiming durability it lacks."""
+        if not self.state_path or not self._dirty:
+            return
+        async with self._flush_lock:
+            if not self._dirty:
+                return          # a racing flush already covered us
+            self._dirty = False
+            payload = self._state_payload()
+            try:
+                await tracing.run_in_executor(self._write_state, payload)
+            except OSError:
+                self._dirty = True
+                raise
 
     def _apply_committed(self) -> None:
         while self.applied < self.commit:
@@ -163,7 +199,7 @@ class Election:
                      "last_term": self._term_at(self.applied) or 0,
                      "value": self.applied_value}
         self.entries = self.entries[cut:]
-        self._persist()
+        self._mark_dirty()
         glog.info("%s: snapshot at index %d (value %d, %d entries kept)",
                   self.me, self.applied, self.applied_value,
                   len(self.entries))
@@ -184,6 +220,15 @@ class Election:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        # drain any dirt a cancelled replication round left behind.
+        # Correctness never depends on this (every acted-on change was
+        # flushed before the action), but a clean shutdown should not
+        # discard a term bump it already observed.
+        try:
+            await self.flush()
+        except OSError as e:
+            glog.warning("%s: final raft-state flush failed: %s",
+                         self.me, e)
         if self._http:
             await self._http.close()
 
@@ -224,7 +269,7 @@ class Election:
             self.voted_for = candidate
             self.last_pulse = time.monotonic()
         if granted or bumped:
-            self._persist()  # durable before the reply leaves this node
+            self._mark_dirty()  # the handler flushes before replying
         return {"term": self.term, "granted": granted}
 
     def on_append(self, term: int, leader: str, prev_index: int,
@@ -239,7 +284,7 @@ class Election:
         if term > self.term:
             self.voted_for = None
             self.term = term
-            self._persist()
+            self._mark_dirty()
         self.leader = leader
         if leader != self.me:
             self._step_down()
@@ -269,7 +314,7 @@ class Election:
                 self.entries.append(e)
                 changed = True
         if changed:
-            self._persist()
+            self._mark_dirty()
         match = prev_index + len(entries)
         if leader_commit > self.commit:
             self.commit = min(leader_commit, self.last_index())
@@ -288,7 +333,7 @@ class Election:
             # persist NOW, even when the snapshot turns out stale below:
             # currentTerm durability must not depend on installation, or
             # a restart forgets the bump and this node can double-vote
-            self._persist()
+            self._mark_dirty()
         self.leader = leader
         self._step_down()
         self.last_pulse = time.monotonic()
@@ -300,7 +345,7 @@ class Election:
             if value > self.applied_value:
                 self.applied_value = value
                 self.adopt_max_volume_id(value)
-            self._persist()
+            self._mark_dirty()
         return {"term": self.term, "ok": True}
 
     # back-compat alias: the round-4 pulse RPC carried the value inline
@@ -344,7 +389,8 @@ class Election:
         self.term += 1
         term = self.term
         self.voted_for = self.me
-        self._persist()  # self-vote must be durable before soliciting
+        self._mark_dirty()
+        await self.flush()   # self-vote durable before soliciting
         self.leader = None
         votes = 1  # self-vote
 
@@ -363,7 +409,7 @@ class Election:
             if body.get("term", 0) > self.term:
                 self.term = body["term"]
                 self.voted_for = None
-                self._persist()
+                self._mark_dirty()
                 self._step_down()
             return bool(body.get("granted"))
 
@@ -459,12 +505,15 @@ class Election:
             if n > self.commit and self._term_at(n) == self.term:
                 self.commit = n
                 self._apply_committed()
+        # snapshot compaction / adopted-higher-term dirt from this
+        # round becomes durable before the next round acts on it
+        await self.flush()
         return acks
 
     def _adopt_higher_term(self, term: int) -> None:
         self.term = term
         self.voted_for = None
-        self._persist()
+        self._mark_dirty()
         self._step_down()
 
     # ---- client surface ----
@@ -483,7 +532,10 @@ class Election:
         if not self.is_leader:
             return False
         self.entries.append({"term": self.term, "cmd": cmd})
-        self._persist()
+        self._mark_dirty()
+        # the leader counts itself in the quorum, so its own log entry
+        # must be durable before any peer acks are tallied
+        await self.flush()
         idx = self.last_index()
         for _ in range(rounds):
             await self._replicate_round()

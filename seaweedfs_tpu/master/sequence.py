@@ -103,7 +103,10 @@ class EtcdSequencer:  # pragma: no cover - driver-gated (no etcd in image)
                 "etcd sequencer needs the etcd3 client installed") from e
         import etcd3
         host, _, port = endpoints.split(",")[0].partition(":")
-        self._client = etcd3.client(host=host, port=int(port or 2379))
+        # explicit per-request deadline: python-etcd3 defaults to NO
+        # timeout, so a wedged etcd would wedge every id reservation
+        self._client = etcd3.client(host=host, port=int(port or 2379),
+                                    timeout=10)
         self.step = step
         self._lock = threading.Lock()
         self._counter = 0

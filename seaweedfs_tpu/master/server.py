@@ -24,7 +24,7 @@ from ..topology.layout import (LayoutKey, PlacementError, VolumeLayout,
                                find_empty_slots)
 from ..topology.tree import DataNode, Topology
 from ..security import tls
-from ..util import failpoints, glog
+from ..util import failpoints, glog, tracing
 from .election import Election
 from .sequence import MemorySequencer
 
@@ -208,6 +208,15 @@ class MasterServer:
     async def start(self) -> None:
         self._http = tls.make_session(
             timeout=aiohttp.ClientTimeout(total=30))
+        # multi-master: the raft state MUST be replayed (executor —
+        # this loop may already serve sibling daemons) BEFORE any
+        # listener goes live. A respawned worker that answered
+        # /dir/assign with `self.election is None` would claim
+        # leadership with no raft state next to the real leader.
+        # Single mode defers until the port is bound (identity may be
+        # an ephemeral :0 here, and single mode is leader by fiat).
+        if self._peers:
+            await self._make_election()
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         # public listener: /dir/assign answered straight off the socket,
@@ -234,14 +243,8 @@ class MasterServer:
                 ssl=tls.server_ctx(), reuse_address=True)
             priv_port = self._priv_server.sockets[0].getsockname()[1]
             wc.write_state(ip=self.ip, port=priv_port, role="master")
-        self.election = Election(
-            self.url, self._peers,
-            election_timeout=self._election_timeout,
-            pulse=self._election_pulse,
-            state_path=(os.path.join(self.meta_dir, "raft_state.json")
-                        if self.meta_dir else None))
-        self.election.get_max_volume_id = lambda: self.topo.max_volume_id
-        self.election.adopt_max_volume_id = self._adopt_max_volume_id
+        if self.election is None:
+            await self._make_election()
         await self.election.start()
         self._tasks.append(asyncio.create_task(self._liveness_loop()))
         if self.maintenance_interval_s > 0:
@@ -317,32 +320,72 @@ class MasterServer:
         (cluster_commands.go:23 MaxVolumeIdCommand)."""
         self.topo.max_volume_id = max(self.topo.max_volume_id, v)
 
+    async def _make_election(self) -> None:
+        """Build the Election (its ctor replays persisted raft state
+        from disk, so it runs on the executor — under `weed-tpu
+        server` and worker respawn this loop already serves traffic)
+        and wire the MaxVolumeId exchange hooks."""
+        self.election = await tracing.run_in_executor(lambda: Election(
+            self.url, self._peers,
+            election_timeout=self._election_timeout,
+            pulse=self._election_pulse,
+            state_path=(os.path.join(self.meta_dir, "raft_state.json")
+                        if self.meta_dir else None)))
+        self.election.get_max_volume_id = lambda: self.topo.max_volume_id
+        self.election.adopt_max_volume_id = self._adopt_max_volume_id
+
+    def _raft_unready(self) -> web.Response | None:
+        """503 while the Election is still being built (single mode
+        binds the port before constructing it; a raft RPC landing in
+        that window means a peer is misconfigured, not that we should
+        500 with an AttributeError)."""
+        if self.election is None:
+            return web.json_response(
+                {"error": "raft state not loaded yet"}, status=503)
+        return None
+
     async def h_raft_vote(self, req: web.Request) -> web.Response:
+        if (err := self._raft_unready()) is not None:
+            return err
         body = await req.json()
         lli = body.get("last_log_index")
-        return web.json_response(self.election.on_vote_request(
+        r = self.election.on_vote_request(
             int(body["term"]), body["candidate"],
             int(body.get("max_volume_id", 0)),
             last_log_index=None if lli is None else int(lli),
-            last_log_term=int(body.get("last_log_term", 0) or 0)))
+            last_log_term=int(body.get("last_log_term", 0) or 0))
+        # raft durability rule: the granted vote / term bump must be
+        # on disk before the reply leaves this node (the fsync runs on
+        # the executor; a failed write 500s instead of lying)
+        await self.election.flush()
+        return web.json_response(r)
 
     async def h_raft_heartbeat(self, req: web.Request) -> web.Response:
+        if (err := self._raft_unready()) is not None:
+            return err
         body = await req.json()
         if "prev_index" in body:
-            return web.json_response(self.election.on_append(
+            r = self.election.on_append(
                 int(body["term"]), body["leader"],
                 int(body["prev_index"]), int(body["prev_term"]),
-                list(body.get("entries", [])), int(body.get("commit", 0))))
-        # legacy pulse (value inline, no log coordinates)
-        return web.json_response(self.election.on_leader_pulse(
-            int(body["term"]), body["leader"],
-            int(body.get("max_volume_id", 0))))
+                list(body.get("entries", [])), int(body.get("commit", 0)))
+        else:
+            # legacy pulse (value inline, no log coordinates)
+            r = self.election.on_leader_pulse(
+                int(body["term"]), body["leader"],
+                int(body.get("max_volume_id", 0)))
+        await self.election.flush()   # appended entries durable pre-ack
+        return web.json_response(r)
 
     async def h_raft_snapshot(self, req: web.Request) -> web.Response:
+        if (err := self._raft_unready()) is not None:
+            return err
         body = await req.json()
-        return web.json_response(self.election.on_install_snapshot(
+        r = self.election.on_install_snapshot(
             int(body["term"]), body["leader"], int(body["last_index"]),
-            int(body["last_term"]), int(body.get("value", 0))))
+            int(body["last_term"]), int(body.get("value", 0)))
+        await self.election.flush()   # term bump / snapshot durable
+        return web.json_response(r)
 
     def _leader_or_503(self) -> tuple[str | None, web.Response | None]:
         """Resolve the current leader, or the 503 every non-leader

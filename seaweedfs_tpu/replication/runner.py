@@ -48,7 +48,9 @@ async def replicate_from_queue(queue, replicator: Replicator,
     (filer_replication.go:37-130)."""
     from .sub import NotificationInput
 
-    offset = _load_progress(progress_path)
+    # progress-file reads/writes are disk I/O like the broker polls —
+    # the loop here is shared with the source/sink aiohttp sessions
+    offset = await tracing.run_in_executor(_load_progress, progress_path)
     applied = 0
     while True:
         tokens = None
@@ -77,7 +79,8 @@ async def replicate_from_queue(queue, replicator: Replicator,
             if tokens is not None:
                 await tracing.run_in_executor(queue.commit, tokens)
             else:
-                _save_progress(progress_path, offset)
+                await tracing.run_in_executor(
+                    _save_progress, progress_path, offset)
         if once:
             return applied
         await asyncio.sleep(poll_interval)

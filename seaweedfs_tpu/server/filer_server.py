@@ -138,9 +138,13 @@ class FilerServer:
     async def start(self) -> None:
         cc = None
         if self.cache_mem_bytes > 0:
+            from ..util import tracing
             from ..util.chunk_cache import TieredChunkCache
-            cc = TieredChunkCache(self.cache_mem_bytes,
-                                  disk_dir=self.cache_dir or None)
+            # ctor makedirs the disk tier — off the loop: under
+            # `weed-tpu server` this loop already serves other daemons
+            cc = await tracing.run_in_executor(
+                lambda: TieredChunkCache(self.cache_mem_bytes,
+                                         disk_dir=self.cache_dir or None))
         self.client = WeedClient(self.master_url, chunk_cache=cc)
         await self.client.__aenter__()
         # watch-fed location map: hot-path reads never lookup the master

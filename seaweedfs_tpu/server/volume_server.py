@@ -1695,7 +1695,9 @@ class VolumeServer:
         vid = int(req.query["volume"])
         v = self.store.volumes.get(vid)
         if v is not None:
-            vacuum.cleanup_compact(v)
+            # unlinks .cpd/.cpx leftovers — disk metadata ops belong
+            # on the executor like every other blocking call here
+            await self._in_executor(vacuum.cleanup_compact, v)
         return web.json_response({"ok": True})
 
     def _base_name(self, vid: int, collection: str) -> str | None:

@@ -213,11 +213,15 @@ async def serve_read(vs, wr: WireRequest) -> WireResponse:
         try:
             async with vs._http.get(
                     tls.url(vs.master_url, "/dir/lookup"),
-                    params={"volumeId": str(vid)}) as resp:
+                    params={"volumeId": str(vid)},
+                    timeout=aiohttp.ClientTimeout(total=5)) as resp:
                 if resp.status != 200:
                     return json_err(404, "volume not found")
                 locs = (await resp.json())["locations"]
-        except (OSError, ValueError, KeyError):
+        except (OSError, ValueError, KeyError,
+                asyncio.TimeoutError, aiohttp.ClientError):
+            # asyncio.TimeoutError is NOT an OSError on py3.10 — a
+            # wedged master must produce the 404, not a 500
             return json_err(404, "volume not found")
         others = [l for l in locs if l["url"] != vs.url]
         if not others:

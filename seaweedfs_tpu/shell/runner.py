@@ -237,10 +237,14 @@ async def dispatch(env: CommandEnv, line: str) -> object:
             res = await fs.fs_meta_cat(env, filer, path)
         elif cmd == "fs.meta.notify":
             from ..notification.queues import queue_from_spec
+            from ..util import tracing
             if "notify" not in flags:
                 raise ValueError("fs.meta.notify requires "
                                  "-notify file:<p>|sqlite:<p>|log")
-            queue = queue_from_spec(flags["notify"])
+            # FileQueue's ctor makedirs/creates its backing file — off
+            # the loop, the shell may be driving live-cluster commands
+            queue = await tracing.run_in_executor(
+                queue_from_spec, flags["notify"])
             try:
                 res = await fs.fs_meta_notify(env, filer, path, queue)
             finally:

@@ -35,12 +35,18 @@ def _crc32c_py(crc: int, data: bytes) -> int:
     return crc ^ 0xFFFFFFFF
 
 
+# Resolved ONCE at import, not lazily per call: load() may shell out
+# to g++ when the cached .so is stale, and a first-call build used to
+# be reachable from every async etag/checksum path — a compiler run on
+# the event loop, mid-request. Import time is before any loop exists.
+_NATIVE = _load_native()
+
+
 def crc32c(data: bytes | bytearray | memoryview, crc: int = 0) -> int:
     """Raw CRC32C of data, continuing from crc."""
-    lib = _load_native()
-    if lib is not None:
+    if _NATIVE is not None:
         data = bytes(data) if not isinstance(data, bytes) else data
-        return lib.swtpu_crc32c(crc, data, len(data))
+        return _NATIVE.swtpu_crc32c(crc, data, len(data))
     return _crc32c_py(crc, bytes(data))
 
 

@@ -231,6 +231,9 @@ def test_vote_state_survives_restart(tmp_path):
     e1 = Election("a:1", peers, state_path=path)
     r = e1.on_vote_request(term=5, candidate="b:2", max_volume_id=10)
     assert r["granted"] and e1.term == 5
+    # durability rides flush() — the RPC handler awaits it before the
+    # reply leaves the node (the fsync itself runs on the executor)
+    asyncio.run(e1.flush())
 
     # crash + restart: state reloads from disk
     e2 = Election("a:1", peers, state_path=path)
@@ -245,6 +248,7 @@ def test_vote_state_survives_restart(tmp_path):
     # a HIGHER term resets votedFor and persists the new term
     r = e2.on_vote_request(term=6, candidate="c:3", max_volume_id=10)
     assert r["granted"]
+    asyncio.run(e2.flush())
     e3 = Election("a:1", peers, state_path=path)
     assert e3.term == 6 and e3.voted_for == "c:3"
 
@@ -267,6 +271,7 @@ def test_stale_snapshot_still_persists_term_bump(tmp_path):
     r = e1.on_install_snapshot(term=9, leader="c:3", last_index=0,
                                last_term=0, value=0)
     assert r["ok"] and e1.term == 9
+    asyncio.run(e1.flush())   # what h_raft_snapshot awaits pre-reply
     # crash + restart: the term bump must have been durable
     e2 = Election("a:1", peers, state_path=path)
     assert e2.term == 9

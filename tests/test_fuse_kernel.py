@@ -85,8 +85,8 @@ def _exercise(mp: str) -> None:
     # truncate through the kernel
     os.truncate(f"{mp}/docs/big.bin", 1000)
     assert os.path.getsize(f"{mp}/docs/big.bin") == 1000
-    assert open(f"{mp}/docs/big.bin", "rb").read() == \
-        payloads[f"{mp}/docs/big.bin"][:1000]
+    with open(f"{mp}/docs/big.bin", "rb") as fh:
+        assert fh.read() == payloads[f"{mp}/docs/big.bin"][:1000]
     # rename across directories, then unlink
     os.rename(f"{mp}/docs/big.bin", f"{mp}/moved.bin")
     assert os.path.getsize(f"{mp}/moved.bin") == 1000

@@ -33,7 +33,8 @@ def test_glog_severity_files(tmp_path):
     assert any("ERROR" in f for f in files)
     joined = ""
     for f in files:
-        joined += open(os.path.join(d, f)).read()
+        with open(os.path.join(d, f)) as fh:
+            joined += fh.read()
     assert "hello-info" in joined and "hello-err" in joined
     glog.init(verbosity=0)  # reset global state for other tests
 

@@ -77,7 +77,8 @@ def test_5byte_idx_log_and_walk(five_byte, tmp_path):
     nm2 = MemoryNeedleMap(path)
     assert nm2.get(2).offset == big
     assert nm2.get(1).size == t.TOMBSTONE_FILE_SIZE
-    entries = list(walk_index_blob(open(path, "rb").read()))
+    with open(path, "rb") as fh:
+        entries = list(walk_index_blob(fh.read()))
     assert entries[1] == (2, big, 200)
     nm2.close()
 

@@ -6,6 +6,8 @@ InstallSnapshot, log-freshness votes). Reference behavior contract:
 
 from __future__ import annotations
 
+import asyncio
+
 from seaweedfs_tpu.master.election import SNAPSHOT_THRESHOLD, Election
 
 PEERS = ["a:1", "b:2", "c:3"]
@@ -63,6 +65,8 @@ def test_snapshot_compaction_and_state_restart(tmp_path):
     assert f.applied_value == n
     assert f.snap["last_index"] == n          # compacted
     assert len(f.entries) <= SNAPSHOT_THRESHOLD
+    # the handler flushes before acking; only then is the state durable
+    asyncio.run(f.flush())
     # restart: snapshot + tail reload, applied value restored
     f2 = _follower("b:2", path)
     assert f2.applied_value == n

@@ -660,6 +660,30 @@ def test_cli_rule_selection_and_unknown_rule(tmp_path, capsys):
     assert rc == 2
 
 
+def test_cli_select_tests_enforced_preset(tmp_path, capsys):
+    """ci.sh enforces tests/ via `--select tests-enforced`; the preset
+    expands from rules.TESTS_ENFORCED_RULE_IDS so growing the constant
+    (plus its test) grows CI too — no second hand-typed list."""
+    from tools.weedlint.rules import TESTS_ENFORCED_RULE_IDS
+    mod = tmp_path / "m.py"
+    mod.write_text(textwrap.dedent("""
+        import time
+        async def h():
+            time.sleep(0.5)          # blocking-io: NOT in the subset
+        def g():
+            try:
+                time.sleep(0)
+            except Exception:
+                pass                 # silent-except: in the subset
+    """))
+    rc = weedlint_main([str(mod), "--select", "tests-enforced",
+                        "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "silent-except" in out and "blocking-io" not in out
+    assert "silent-except" in TESTS_ENFORCED_RULE_IDS
+
+
 def test_cli_list_rules(capsys):
     assert weedlint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
@@ -679,9 +703,115 @@ def test_module_entrypoint_runs():
 def test_rule_catalog_is_documented():
     """STATIC_ANALYSIS.md documents every registered rule id, and
     every rule carries the metadata the catalog is built from."""
-    doc = open(os.path.join(REPO, "STATIC_ANALYSIS.md"),
-               encoding="utf-8").read()
+    with open(os.path.join(REPO, "STATIC_ANALYSIS.md"),
+              encoding="utf-8") as fh:
+        doc = fh.read()
     for cls in ALL_RULE_CLASSES:
         assert cls.id and cls.title and cls.rationale and cls.fix, cls
         assert f"`{cls.id}`" in doc, \
             f"rule {cls.id} missing from STATIC_ANALYSIS.md"
+
+
+# ---------------------------------------------------------------------
+# PR 9: two-phase enforcement surface
+# ---------------------------------------------------------------------
+
+def test_tests_tree_is_clean_for_enforced_subset():
+    """The safe rule subset is ENFORCED over tests/ (exception/task/fd
+    hygiene applies to test code too; suppress-format is always on).
+    The remaining rules stay report-only — fixtures legitimately trip
+    them."""
+    from tools.weedlint.rules import TESTS_ENFORCED_RULE_IDS
+    result = lint([os.path.join(REPO, "tests")],
+                  select=list(TESTS_ENFORCED_RULE_IDS),
+                  baseline_path="-")
+    assert result.problems == [], "\n".join(
+        f.render() for f in result.problems)
+
+
+def test_phase2_rules_are_registered_and_cataloged():
+    from tools.weedlint.rules import ADVISORY_RULE_IDS
+    for rule_id in ("transitive-blocking", "lock-order",
+                    "timeout-discipline", "transitive-orphan-span",
+                    "docs-drift", "unresolved-call"):
+        assert rule_id in ALL_RULE_IDS
+    assert "unresolved-call" in ADVISORY_RULE_IDS
+
+
+def test_changed_mode_clean_on_no_changes(tmp_path, capsys):
+    """--changed vs a ref with no touched files exits 0 fast (the
+    pre-commit fast path)."""
+    import subprocess as sp
+    repo = str(tmp_path)
+
+    def git(*args):
+        sp.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                *args], cwd=repo, check=True, capture_output=True)
+
+    git("init", "-q")
+    (tmp_path / "a.py").write_text("x = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "init")
+    from tools.weedlint import cli as wl_cli
+    old = wl_cli.REPO
+    wl_cli.REPO = repo
+    try:
+        rc = weedlint_main([str(tmp_path), "--changed", "HEAD",
+                            "--no-baseline"])
+    finally:
+        wl_cli.REPO = old
+    out = capsys.readouterr().out
+    assert rc == 0 and "nothing changed" in out
+
+
+def test_changed_mode_lints_only_touched_files(tmp_path, capsys):
+    import subprocess as sp
+    repo = str(tmp_path)
+
+    def git(*args):
+        sp.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                *args], cwd=repo, check=True, capture_output=True)
+
+    git("init", "-q")
+    clean = tmp_path / "clean.py"
+    clean.write_text(BAD_SRC)            # bad, but NOT touched
+    touched = tmp_path / "touched.py"
+    touched.write_text("x = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "init")
+    touched.write_text(BAD_SRC)          # now it fires
+    from tools.weedlint import cli as wl_cli
+    old = wl_cli.REPO
+    wl_cli.REPO = repo
+    try:
+        rc = weedlint_main([str(tmp_path), "--changed", "HEAD",
+                            "--no-baseline"])
+    finally:
+        wl_cli.REPO = old
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "touched.py" in out and "clean.py" not in out
+
+
+def test_jobs_parallel_output_matches_serial(tmp_path, capsys):
+    """--jobs N is a pure speedup: path-sorted findings, byte-equal
+    JSON to the serial run."""
+    for i in range(6):
+        (tmp_path / f"m{i}.py").write_text(BAD_SRC)
+    rc1 = weedlint_main([str(tmp_path), "--format", "json",
+                         "--no-baseline"])
+    serial = capsys.readouterr().out
+    rc2 = weedlint_main([str(tmp_path), "--format", "json",
+                         "--no-baseline", "--jobs", "4"])
+    parallel = capsys.readouterr().out
+    assert (rc1, serial) == (rc2, parallel)
+    assert json.loads(serial)["summary"] == {"blocking-io": 6}
+
+
+def test_stats_flag_prints_resolution_metrics(tmp_path, capsys):
+    (tmp_path / "a.py").write_text("def f():\n    return g()\n"
+                                   "def g():\n    return 1\n")
+    rc = weedlint_main([str(tmp_path), "--no-baseline", "--stats"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "call resolution:" in out and "unresolved" in out

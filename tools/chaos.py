@@ -28,7 +28,6 @@ import json
 import os
 import random
 import signal
-import subprocess
 import sys
 import tempfile
 import time
@@ -37,6 +36,11 @@ import urllib.request
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import procutil  # noqa: E402
+
+Procs = procutil.Procs
+wait_assign = procutil.wait_assign
 
 BASE_PORT = 23400
 
@@ -50,43 +54,6 @@ VOLUME_FAILPOINTS = {
 }
 VOLUME_LATENCY = {"store.read": "latency=80@0.05"}  # alternate arming
 MASTER_FAILPOINTS = {"master.assign": "latency=50@0.05"}
-
-
-class Procs:
-    def __init__(self, tmp: str):
-        self.tmp = tmp
-        self.procs: list[subprocess.Popen] = []
-        self.env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
-
-    def spawn(self, *args: str) -> subprocess.Popen:
-        log = open(os.path.join(self.tmp, f"proc{len(self.procs)}.log"),
-                   "w")
-        p = subprocess.Popen(
-            [sys.executable, "-m", "seaweedfs_tpu.cli", *args],
-            stdout=log, stderr=subprocess.STDOUT, env=self.env, cwd=REPO)
-        self.procs.append(p)
-        return p
-
-    def kill_all(self) -> None:
-        for p in self.procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGKILL)
-        for p in self.procs:
-            p.wait(timeout=10)
-
-
-def wait_assign(master: str, params: str = "", tries: int = 45) -> None:
-    for _ in range(tries):
-        try:
-            with urllib.request.urlopen(
-                    f"http://{master}/dir/assign?{params}",
-                    timeout=3) as r:
-                if b"fid" in r.read():
-                    return
-        except OSError:
-            pass
-        time.sleep(1)
-    raise RuntimeError("cluster never became assignable")
 
 
 def http_json(url: str, method: str = "GET",
@@ -236,7 +203,7 @@ async def run(args) -> int:
     report: dict = {"mode": "quick" if args.quick else "soak"}
     try:
         master = f"127.0.0.1:{BASE_PORT}"
-        procs.spawn("master", "-port", str(BASE_PORT),
+        await procs.spawn("master", "-port", str(BASE_PORT),
                     "-mdir", os.path.join(tmp, "m"),
                     "-volumeSizeLimitMB", "8", "-pulseSeconds", "1",
                     "-defaultReplication", "001")
@@ -248,11 +215,11 @@ async def run(args) -> int:
             # failpoints do, which would make the recorder report a lie
             slo_flags = (("-slo", "volume.read:p99<250ms@99")
                          if args.slo else ())
-            procs.spawn("volume", "-port", str(BASE_PORT + 1 + i),
+            await procs.spawn("volume", "-port", str(BASE_PORT + 1 + i),
                         "-dir", os.path.join(tmp, f"v{i}"),
                         "-max", "20", "-master", master,
                         "-pulseSeconds", "1", *slo_flags)
-        wait_assign(master, "replication=001")
+        await wait_assign(master, "replication=001", tries=45)
 
         # runtime arming over the live admin endpoint (this also IS the
         # endpoint's integration test)

@@ -48,26 +48,14 @@ import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import procutil  # noqa: E402
 
 BASE_PORT = 21500
 
 
-class Procs:
-    def __init__(self, tmp: str):
-        self.tmp = tmp
-        self.procs: list[subprocess.Popen] = []
-        self.env = dict(os.environ, JAX_PLATFORMS="cpu",
-                        PYTHONPATH=REPO)
-
-    def spawn(self, *args: str) -> subprocess.Popen:
-        log = open(os.path.join(
-            self.tmp, f"proc{len(self.procs)}.log"), "w")
-        p = subprocess.Popen(
-            [sys.executable, "-m", "seaweedfs_tpu.cli", *args],
-            stdout=log, stderr=subprocess.STDOUT, env=self.env, cwd=REPO)
-        self.procs.append(p)
-        return p
-
+class Procs(procutil.Procs):
     def shell(self, master: str, cmd: str) -> str:
         # timeout: a shell command wedged on a dead server must fail
         # the scenario, not hang the soak forever
@@ -78,26 +66,8 @@ class Procs:
             timeout=180)
         return out.stdout + out.stderr
 
-    def kill_all(self) -> None:
-        for p in self.procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGKILL)
-        for p in self.procs:
-            p.wait(timeout=10)
 
-
-def wait_assign(master: str, params: str = "", tries: int = 30) -> None:
-    for _ in range(tries):
-        try:
-            with urllib.request.urlopen(
-                    f"http://{master}/dir/assign?{params}",
-                    timeout=3) as r:
-                if b"fid" in r.read():
-                    return
-        except OSError:
-            pass
-        time.sleep(1)
-    raise RuntimeError("cluster never became assignable")
+wait_assign = procutil.wait_assign
 
 
 async def fill(client, payloads: dict, n: int, rng,
@@ -135,16 +105,16 @@ async def verify(client, payloads: dict, tag: str) -> int:
     return len(bad)
 
 
-def cluster(procs: Procs, port0: int, n_servers: int,
+async def cluster(procs: Procs, port0: int, n_servers: int,
             master_args: tuple[str, ...] = ()) -> str:
     master = f"127.0.0.1:{port0}"
-    procs.spawn("master", "-port", str(port0),
+    await procs.spawn("master", "-port", str(port0),
                 "-mdir", os.path.join(procs.tmp, "m"),
                 "-volumeSizeLimitMB", "8", "-pulseSeconds", "1",
                 *master_args)
-    time.sleep(2)
+    await asyncio.sleep(2)
     for i in range(n_servers):
-        procs.spawn("volume", "-port", str(port0 + 1 + i),
+        await procs.spawn("volume", "-port", str(port0 + 1 + i),
                     "-dir", os.path.join(procs.tmp, f"v{i}"),
                     "-max", "20", "-master", master,
                     "-pulseSeconds", "1")
@@ -155,8 +125,8 @@ async def scenario_ec(tmp: str) -> int:
     from seaweedfs_tpu.util.client import WeedClient
     procs = Procs(tmp)
     try:
-        master = cluster(procs, BASE_PORT, 3)
-        wait_assign(master, "replication=001")
+        master = await cluster(procs, BASE_PORT, 3)
+        await wait_assign(master, "replication=001")
         rng = random.Random(42)
         payloads: dict = {}
         async with WeedClient(master) as c:
@@ -180,8 +150,8 @@ async def scenario_vacuum_race(tmp: str) -> int:
     from seaweedfs_tpu.util.client import WeedClient
     procs = Procs(tmp)
     try:
-        master = cluster(procs, BASE_PORT + 10, 2)
-        wait_assign(master)
+        master = await cluster(procs, BASE_PORT + 10, 2)
+        await wait_assign(master)
         rng = random.Random(9)
         payloads: dict = {}
         stop = asyncio.Event()
@@ -228,8 +198,8 @@ async def scenario_rebuild(tmp: str) -> int:
     from seaweedfs_tpu.util.client import WeedClient
     procs = Procs(tmp)
     try:
-        master = cluster(procs, BASE_PORT + 20, 4)
-        wait_assign(master)
+        master = await cluster(procs, BASE_PORT + 20, 4)
+        await wait_assign(master)
         rng = random.Random(12)
         payloads: dict = {}
         async with WeedClient(master) as c:
@@ -263,19 +233,19 @@ async def scenario_failover(tmp: str) -> int:
         port0 = BASE_PORT + 30
         peers = ",".join(f"127.0.0.1:{port0 + i}" for i in range(3))
         for i in range(3):
-            procs.spawn("master", "-port", str(port0 + i),
+            await procs.spawn("master", "-port", str(port0 + i),
                         "-mdir", os.path.join(procs.tmp, f"m{i}"),
                         "-peers", peers, "-pulseSeconds", "1",
                         "-sequencer",
                         f"file:{os.path.join(procs.tmp, f'seq{i}')}")
         await asyncio.sleep(4)
         for i in range(2):
-            procs.spawn("volume", "-port", str(port0 + 10 + i),
+            await procs.spawn("volume", "-port", str(port0 + 10 + i),
                         "-dir", os.path.join(procs.tmp, f"v{i}"),
                         "-max", "16", "-master", peers,
                         "-pulseSeconds", "1")
         first = f"127.0.0.1:{port0}"
-        wait_assign(first, "replication=001")
+        await wait_assign(first, "replication=001")
         with urllib.request.urlopen(
                 f"http://{first}/cluster/status", timeout=5) as r:
             leader = json.load(r)["leader"]
@@ -406,7 +376,7 @@ async def scenario_partition(tmp: str) -> int:
             peer_list = ",".join(
                 [real[i]] + [f"127.0.0.1:{qport[(i, j)]}"
                              for j in range(3) if j != i])
-            procs.spawn("master", "-port", str(port0 + i),
+            await procs.spawn("master", "-port", str(port0 + i),
                         "-mdir", os.path.join(procs.tmp, f"m{i}"),
                         "-peers", peer_list, "-pulseSeconds", "1",
                         "-sequencer",
@@ -415,11 +385,11 @@ async def scenario_partition(tmp: str) -> int:
         # on THIS loop — blocking it severs every raft link at once
         await asyncio.sleep(4)
         for i in range(2):
-            procs.spawn("volume", "-port", str(port0 + 10 + i),
+            await procs.spawn("volume", "-port", str(port0 + 10 + i),
                         "-dir", os.path.join(procs.tmp, f"v{i}"),
                         "-max", "16", "-master", ",".join(real),
                         "-pulseSeconds", "1")
-        await asyncio.to_thread(wait_assign, real[0], "replication=001")
+        await wait_assign(real[0], "replication=001")
 
         def status(url):
             with urllib.request.urlopen(
@@ -564,16 +534,16 @@ async def scenario_workers(tmp: str) -> int:
     try:
         port0 = BASE_PORT + 60
         master = f"127.0.0.1:{port0}"
-        procs.spawn("master", "-port", str(port0),
+        await procs.spawn("master", "-port", str(port0),
                     "-mdir", os.path.join(procs.tmp, "m"),
                     "-volumeSizeLimitMB", "8", "-pulseSeconds", "1")
         await asyncio.sleep(2)
         vport = port0 + 1
-        procs.spawn("volume", "-port", str(vport),
+        await procs.spawn("volume", "-port", str(vport),
                     "-dir", os.path.join(procs.tmp, "v0"),
                     "-max", "20", "-master", master,
                     "-pulseSeconds", "1", "-workers", "2")
-        wait_assign(master)
+        await wait_assign(master)
 
         def worker_rows():
             with urq.urlopen(f"http://127.0.0.1:{vport}/stats/workers",
@@ -679,17 +649,17 @@ async def scenario_cache_churn(tmp: str) -> int:
     try:
         port0 = BASE_PORT + 70
         master = f"127.0.0.1:{port0}"
-        procs.spawn("master", "-port", str(port0),
+        await procs.spawn("master", "-port", str(port0),
                     "-mdir", os.path.join(procs.tmp, "m"),
                     "-volumeSizeLimitMB", "8", "-pulseSeconds", "1")
         await asyncio.sleep(2)
         vport = port0 + 1
-        procs.spawn("volume", "-port", str(vport),
+        await procs.spawn("volume", "-port", str(vport),
                     "-dir", os.path.join(procs.tmp, "v"),
                     "-max", "20", "-master", master,
                     "-pulseSeconds", "1", "-workers", "2",
                     "-cache.mem", "16")
-        wait_assign(master)
+        await wait_assign(master)
 
         rng = random.Random(5)
         payloads: dict = {}
@@ -698,7 +668,7 @@ async def scenario_cache_churn(tmp: str) -> int:
         stats = {"reads": 0, "stale": 0, "transient": 0,
                  "overwrites": 0, "deletes": 0, "batched": 0}
         async with WeedClient(
-                master, chunk_cache=TieredChunkCache(8 << 20)) as c:
+                master, chunk_cache=await asyncio.to_thread(TieredChunkCache, 8 << 20)) as c:
             await fill(c, payloads, n_files, rng, replication="000")
             fid_list = sorted(payloads)
             for f in fid_list:
@@ -867,19 +837,19 @@ async def scenario_scrub(tmp: str) -> int:
     try:
         port0 = BASE_PORT + 80
         master = f"127.0.0.1:{port0}"
-        procs.spawn("master", "-port", str(port0),
+        await procs.spawn("master", "-port", str(port0),
                     "-mdir", os.path.join(procs.tmp, "m"),
                     "-volumeSizeLimitMB", "8", "-pulseSeconds", "1")
         await asyncio.sleep(2)
         vport = port0 + 1
         vdir = os.path.join(procs.tmp, "v")
-        procs.spawn("volume", "-port", str(vport), "-dir", vdir,
+        await procs.spawn("volume", "-port", str(vport), "-dir", vdir,
                     "-max", "20", "-master", master,
                     "-pulseSeconds", "1",
                     "-scrub.mbps", str(mbps),
                     "-scrub.interval", "3600",   # loop alive, cycles
                     "-scrub.pausems", "500")     # driven via ?run=1
-        wait_assign(master)
+        await wait_assign(master)
         rng = random.Random(77)
         payloads: dict = {}
         async with WeedClient(master) as c:
@@ -1005,12 +975,12 @@ async def scenario_slo(tmp: str) -> int:
     try:
         port0 = BASE_PORT + 120
         master = f"127.0.0.1:{port0}"
-        procs.spawn("master", "-port", str(port0),
+        await procs.spawn("master", "-port", str(port0),
                     "-mdir", os.path.join(procs.tmp, "m"),
                     "-volumeSizeLimitMB", "8", "-pulseSeconds", "1")
         await asyncio.sleep(2)
         vport = port0 + 1
-        procs.spawn("volume", "-port", str(vport),
+        await procs.spawn("volume", "-port", str(vport),
                     "-dir", os.path.join(procs.tmp, "v"),
                     "-max", "20", "-master", master,
                     "-pulseSeconds", "1", "-workers", "2",
@@ -1020,7 +990,7 @@ async def scenario_slo(tmp: str) -> int:
                     # server-side reads sit well under it, the armed
                     # latency failpoint far over it
                     "-slo", "volume.read:p99<150ms@99")
-        wait_assign(master)
+        await wait_assign(master)
         rng = random.Random(99)
         payloads: dict = {}
 

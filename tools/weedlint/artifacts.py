@@ -1,0 +1,282 @@
+"""Cross-artifact extraction for the docs-drift pass.
+
+The last four PRs each grew a hand-maintained catalog — CLI flags in
+README/OBSERVABILITY, metric names and `/debug` surfaces in
+OBSERVABILITY, journal event types and failpoint sites in ROBUSTNESS,
+scrub knobs in EC.md — and none of them has ever been machine-checked
+against the code. This module pulls the five artifact families out of
+the AST (never by running anything) and out of the markdown (never by
+guessing prose), so rules/drift.py can diff the two:
+
+- **flags**       — every `add_argument("-x", ...)` in
+  seaweedfs_tpu/cli.py;
+- **metrics**     — the first argument of every
+  Counter/Gauge/Histogram/Summary construction;
+- **events**      — the first argument of every `events.record(...)`;
+- **failpoints**  — the first argument of every
+  `failpoints.fail/sync_fail/corrupt(...)` planted in the package
+  (tools/ *arms* sites, it doesn't plant them);
+- **routes**      — every `/debug/<name>` / `/__debug__/<name>`
+  string constant (registration and dispatch comparisons are both the
+  live surface; names are normalized to the tail segment so the
+  gateway twins don't double-count).
+
+Doc side, two strictnesses:
+
+- a *mention* is any match inside the scanned catalogs (README.md,
+  OBSERVABILITY.md, ROBUSTNESS.md, EC.md) — code with no mention
+  anywhere is **undocumented**;
+- a *claim* is an entry in a designated catalog table (flag tables,
+  the ROBUSTNESS `| site |` / `| type |` tables) or, for the families
+  with an unambiguous lexical shape (metrics, routes), any token in
+  any scanned doc — a claim naming nothing in the code is **dead**.
+
+Metric tokens understand the docs' two compression idioms:
+`SeaweedFS_disk_{free,used}_bytes` expands mid-token braces, and a
+token ending in `_` or `*` is a family prefix that must match at
+least one live metric.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+from .core import REPO
+from .symbols import SymbolTable, chain_of
+
+#: the catalogs docs-drift diffs against (repo-relative)
+DOC_FILES = ("README.md", "OBSERVABILITY.md", "ROBUSTNESS.md", "EC.md")
+
+_METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram", "Summary"})
+# fail/sync_fail/corrupt raise at the site; take/pending are the
+# response-phase form (wire.py's volume.read.http) — all five plant
+_FAILPOINT_FNS = frozenset({"fail", "sync_fail", "corrupt", "take",
+                            "pending"})
+_ROUTE_RE = re.compile(r"^/(?:debug|__debug__)/([a-z_]+)$")
+
+_FLAG_TOKEN_RE = re.compile(r"(?<![\w-])-([a-zA-Z][a-zA-Z0-9.]*)")
+_METRIC_TOKEN_RE = re.compile(r"SeaweedFS_[A-Za-z0-9_{},*]*")
+_ROUTE_TOKEN_RE = re.compile(r"/(?:debug|__debug__)/([a-z_]+)")
+_BACKTICK_RE = re.compile(r"`([^`]+)`")
+
+
+@dataclass
+class Artifact:
+    """One name the code defines, with every site it appears at."""
+
+    name: str
+    rel: str
+    line: int
+
+
+@dataclass
+class DocClaim:
+    """One name a catalog table (or unambiguous doc token) asserts."""
+
+    name: str
+    rel: str
+    line: int
+
+
+@dataclass
+class CodeArtifacts:
+    flags: dict[str, Artifact] = field(default_factory=dict)
+    metrics: dict[str, Artifact] = field(default_factory=dict)
+    events: dict[str, Artifact] = field(default_factory=dict)
+    failpoints: dict[str, Artifact] = field(default_factory=dict)
+    routes: dict[str, Artifact] = field(default_factory=dict)
+
+
+@dataclass
+class DocArtifacts:
+    """mentions: name -> True (any reference counts as documentation);
+    claims per family: entries that must name live code."""
+
+    flag_mentions: set[str] = field(default_factory=set)
+    metric_mentions: list[str] = field(default_factory=list)
+    event_mentions: set[str] = field(default_factory=set)
+    failpoint_mentions: set[str] = field(default_factory=set)
+    route_mentions: set[str] = field(default_factory=set)
+
+    flag_claims: list[DocClaim] = field(default_factory=list)
+    metric_claims: list[DocClaim] = field(default_factory=list)
+    event_claims: list[DocClaim] = field(default_factory=list)
+    failpoint_claims: list[DocClaim] = field(default_factory=list)
+    route_claims: list[DocClaim] = field(default_factory=list)
+
+
+# -- code side -----------------------------------------------------------
+
+def _first_str_arg(node: ast.Call) -> str | None:
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _add(family: dict[str, Artifact], name: str, rel: str,
+         line: int) -> None:
+    family.setdefault(name, Artifact(name, rel, line))
+
+
+def extract_code(table: SymbolTable) -> CodeArtifacts:
+    out = CodeArtifacts()
+    for mod in table.modules.values():
+        # segment match, not prefix: fixture trees under a tmp dir
+        # carry absolute rels but the same package layout
+        in_pkg = "seaweedfs_tpu/" in mod.rel
+        is_cli = mod.rel.endswith("seaweedfs_tpu/cli.py")
+        if not in_pkg:
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                m = _ROUTE_RE.match(node.value)
+                if m:
+                    _add(out.routes, m.group(1), mod.rel, node.lineno)
+            if not isinstance(node, ast.Call):
+                continue
+            chain = chain_of(node.func)
+            if not chain:
+                continue
+            tail = chain[-1]
+            arg = _first_str_arg(node)
+            if is_cli and tail == "add_argument" and arg \
+                    and arg.startswith("-") and not arg.startswith("--"):
+                _add(out.flags, arg.lstrip("-"), mod.rel, node.lineno)
+            elif tail in _METRIC_CTORS and arg \
+                    and arg.startswith("SeaweedFS_"):
+                _add(out.metrics, arg, mod.rel, node.lineno)
+            elif tail == "record" and len(chain) >= 2 \
+                    and chain[-2] == "events" and arg:
+                _add(out.events, arg, mod.rel, node.lineno)
+            elif tail in _FAILPOINT_FNS and len(chain) >= 2 \
+                    and chain[-2] == "failpoints" and arg:
+                _add(out.failpoints, arg, mod.rel, node.lineno)
+    return out
+
+
+# -- doc side ------------------------------------------------------------
+
+def _expand_metric_token(tok: str) -> list[str]:
+    """'SeaweedFS_disk_{free,used}_bytes{path}' ->
+    ['SeaweedFS_disk_free_bytes', 'SeaweedFS_disk_used_bytes'].
+    A trailing brace group is a label set, not alternatives — strip it.
+    Returns [] for tokens that carry no name (pure 'SeaweedFS_')."""
+    tok = re.sub(r"\{[^}]*\}$", "", tok)
+    head, sep, tail = tok.rpartition("{")
+    if sep and "}" not in tail:
+        # the source regex stops at '=' / '"', so a labeled example
+        # like SeaweedFS_x_total{volume="1"} arrives with an UNCLOSED
+        # brace — that trailing fragment is a label set, not part of
+        # the name
+        tok = head
+    m = re.match(r"^([A-Za-z0-9_]*)\{([^}]*)\}([A-Za-z0-9_*]*)$", tok)
+    if m:
+        head, alts, rest = m.groups()
+        return [v for a in alts.split(",")
+                for v in _expand_metric_token(head + a.strip() + rest)]
+    if "{" in tok or "}" in tok or "," in tok:
+        return []
+    return [tok] if tok != "SeaweedFS_" else []
+
+
+def _is_prefix_token(tok: str) -> bool:
+    return tok.endswith("*") or tok.endswith("_")
+
+
+def _table_cell_claims(lines: list[str], header_key: str,
+                       rel: str) -> list[DocClaim]:
+    """First-column backtick tokens of every markdown table whose
+    header's first cell is `header_key` (e.g. 'site', 'type'). Cells
+    like `` `volume_mount` / `volume_unmount` `` claim both names."""
+    claims: list[DocClaim] = []
+    in_table = False
+    for i, line in enumerate(lines, 1):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            in_table = False
+            continue
+        cells = [c.strip() for c in stripped.strip("|").split("|")]
+        if not cells:
+            continue
+        first = cells[0].strip("* ").lower()
+        if first == header_key:
+            in_table = True
+            continue
+        if set(cells[0]) <= {"-", ":", " "}:
+            continue
+        if in_table:
+            for tok in _BACKTICK_RE.findall(cells[0]):
+                for part in re.split(r"[\s/]+", tok):
+                    if part:
+                        claims.append(DocClaim(part, rel, i))
+    return claims
+
+
+def _flag_table_claims(lines: list[str], rel: str) -> list[DocClaim]:
+    """Backticked `-flag` first cells of any markdown table row — the
+    designated flag catalogs (README flag reference, OBSERVABILITY
+    flags table). Prose mentions of a flag are free; a table row is a
+    claim that the flag exists."""
+    claims: list[DocClaim] = []
+    for i, line in enumerate(lines, 1):
+        stripped = line.strip()
+        if not stripped.startswith("|"):
+            continue
+        first = stripped.strip("|").split("|", 1)[0].strip()
+        for tok in _BACKTICK_RE.findall(first):
+            m = _FLAG_TOKEN_RE.match(tok)
+            if m and tok.startswith("-"):
+                claims.append(DocClaim(m.group(1), rel, i))
+    return claims
+
+
+def extract_docs(repo: str = REPO,
+                 doc_files=DOC_FILES) -> DocArtifacts:
+    out = DocArtifacts()
+    for rel in doc_files:
+        path = os.path.join(repo, rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            continue
+        for i, line in enumerate(lines, 1):
+            for span in _BACKTICK_RE.findall(line):
+                for m in _FLAG_TOKEN_RE.finditer(span):
+                    out.flag_mentions.add(m.group(1))
+            for tok in _METRIC_TOKEN_RE.findall(line):
+                for name in _expand_metric_token(tok):
+                    out.metric_mentions.append(name)
+                    out.metric_claims.append(DocClaim(name, rel, i))
+            for m in _ROUTE_TOKEN_RE.finditer(line):
+                out.route_mentions.add(m.group(1))
+                out.route_claims.append(DocClaim(m.group(1), rel, i))
+            for span in _BACKTICK_RE.findall(line):
+                out.event_mentions.add(span.strip())
+                out.failpoint_mentions.add(span.strip())
+        out.flag_claims += _flag_table_claims(lines, rel)
+        out.event_claims += _table_cell_claims(lines, "type", rel)
+        out.failpoint_claims += _table_cell_claims(lines, "site", rel)
+    return out
+
+
+def metric_documented(name: str, mentions: list[str]) -> bool:
+    for tok in mentions:
+        if _is_prefix_token(tok):
+            if name.startswith(tok.rstrip("*")):
+                return True
+        elif name == tok:
+            return True
+    return False
+
+
+def metric_claim_live(tok: str, code: dict[str, Artifact]) -> bool:
+    if _is_prefix_token(tok):
+        prefix = tok.rstrip("*")
+        return any(n.startswith(prefix) for n in code)
+    return tok in code

@@ -1,0 +1,361 @@
+"""Phase-2 call graph: per-function call sites, bounded resolution,
+and the property-propagation substrate the interprocedural rules run
+on.
+
+Every function body is walked once (its OWN body — nested defs and
+lambdas are separate schedulable units and are never inlined into
+their parent, matching phase 1's executor-thunk exemption). Each call
+is classified:
+
+- ``blocking``   — a known loop-stalling primitive (the same table
+  phase 1's blocking-io rule uses: time.sleep, os.pread, open, ...);
+- ``resolved``   — we can name the in-tree FunctionInfo it lands on
+  (module functions, `self.`/`cls.` methods through the bounded MRO,
+  `self.attr.method` through the attr-type heuristic, local
+  `x = Ctor(); x.method()`, imports and from-imports);
+- ``external``   — provably out of tree (stdlib/third-party modules,
+  builtin container/str methods);
+- ``unresolved`` — everything else. Resolution is deliberately
+  bounded; the rate of unresolved candidates is the precision metric
+  the `unresolved-call` diagnostic reports and
+  tests/test_callgraph.py ceilings.
+
+Executor boundaries: a call THROUGH ``run_in_executor`` /
+``to_thread`` / ``tracing.run_in_executor`` is an edge to the event
+loop's thread pool, not to the thunk — the thunk's blocking I/O is
+sanctioned. Only direct (inline) calls create propagation edges, so
+``transitive-blocking`` stops exactly where the loop stops executing.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+
+from .rules.asynchrony import _BLOCKING_ATTRS, _BLOCKING_NAMES
+from .symbols import (EXTERNAL_MODULES, FunctionInfo, SymbolTable,
+                      chain_of)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_BUILTIN_NAMES = frozenset(dir(builtins))
+
+# receiver-agnostic methods of builtin containers/str/bytes/int:
+# chains ending in these are classified external (no resolution
+# attempt) so dict.get()/list.append() noise doesn't drown the
+# unresolved-call precision metric
+BUILTIN_METHODS = frozenset({
+    "append", "appendleft", "extend", "insert", "remove", "reverse",
+    "sort", "clear", "copy", "pop", "popleft", "popitem", "keys",
+    "values", "items", "get", "setdefault", "update", "add",
+    "discard", "union", "intersection", "difference", "isdisjoint",
+    "issubset", "issuperset", "startswith", "endswith", "split",
+    "rsplit", "splitlines", "strip", "lstrip", "rstrip", "lower",
+    "upper", "title", "capitalize", "casefold", "swapcase", "encode",
+    "decode", "format", "format_map", "join", "partition",
+    "rpartition", "replace", "find", "rfind", "index", "rindex",
+    "count", "zfill", "ljust", "rjust", "center", "expandtabs",
+    "translate", "maketrans", "isdigit", "isalpha", "isalnum",
+    "isspace", "isidentifier", "isupper", "islower", "istitle",
+    "hex", "to_bytes", "from_bytes", "bit_length", "as_integer_ratio",
+    "hexdigest", "digest", "total_seconds", "timestamp", "isoformat",
+    "strftime", "strptime", "group", "groups", "groupdict", "match",
+    "search", "fullmatch", "finditer", "findall", "sub", "subn",
+})
+
+# calling THROUGH these runs the referenced thunk off the event loop
+EXECUTOR_TAILS = frozenset({"run_in_executor", "to_thread", "submit"})
+
+# Sanctioned sinks: functions whose blocking is accepted BY DESIGN and
+# documented in STATIC_ANALYSIS.md. The bar for an entry is high — it
+# must be bounded, rare-or-amortized I/O whose async alternative would
+# cost more than it saves. Today that is exactly one function: glog's
+# emitter (small line writes; the file open amortizes over a 64MB
+# rotation; making logging async would reorder crash-time evidence and
+# every production asyncio stack logs synchronously for the same
+# reason). transitive-blocking stops its walk here the same way it
+# stops at an executor boundary.
+SANCTIONED_SINKS = frozenset({
+    "seaweedfs_tpu.util.glog._emit",
+})
+
+
+class CallSite:
+    __slots__ = ("node", "lineno", "chain", "kind", "target", "what")
+
+    def __init__(self, node: ast.Call, chain, kind: str,
+                 target: FunctionInfo | None = None, what: str = ""):
+        self.node = node
+        self.lineno = node.lineno
+        self.chain = chain
+        self.kind = kind            # blocking|resolved|external|unresolved
+        self.target = target
+        self.what = what            # blocking primitive / unresolved head
+
+    def __repr__(self) -> str:  # pragma: no cover
+        t = self.target.qual if self.target else self.what
+        return f"<call {self.kind}:{t} @{self.lineno}>"
+
+
+def iter_own_nodes(fn_node: ast.AST):
+    """Every node of `fn_node`'s own body, never descending into
+    nested defs/lambdas (they run on their own schedule)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FUNC_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _normalize(table: SymbolTable, fi: FunctionInfo, chain):
+    """Rewrite a from-import alias head to its (module, symbol) form
+    so `from time import sleep; sleep()` matches the blocking table."""
+    if not chain:
+        return chain
+    fs = fi.module.from_symbols.get(chain[0])
+    if fs and fs[0] and fs[0].split(".")[0] in EXTERNAL_MODULES:
+        return (fs[0].split(".")[-1], fs[1]) + chain[1:]
+    return chain
+
+
+def classify_blocking(table: SymbolTable, fi: FunctionInfo,
+                      chain) -> str:
+    """'' or the blocking primitive name ('os.pread', 'open')."""
+    chain = _normalize(table, fi, chain)
+    if not chain:
+        return ""
+    if len(chain) == 1 and chain[0] in _BLOCKING_NAMES:
+        return chain[0]
+    if len(chain) == 2 and chain[1] in _BLOCKING_ATTRS.get(chain[0],
+                                                           ()):
+        return f"{chain[0]}.{chain[1]}"
+    return ""
+
+
+def _annotation_chain(ann) -> tuple[str, ...] | None:
+    """A parameter annotation as a resolvable class chain: plain
+    names/attributes, string forms ('VolumeServer'), and the X of
+    `X | None`. Subscripts (list[dict], Optional[...]) are containers,
+    not receiver types — skipped."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        parts = ann.value.strip().split(".")
+        if all(p.isidentifier() for p in parts):
+            return tuple(parts)
+        return None
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        left = _annotation_chain(ann.left)
+        if left is not None:
+            return left
+        return _annotation_chain(ann.right)
+    if isinstance(ann, (ast.Name, ast.Attribute)):
+        return chain_of(ann)
+    return None
+
+
+class Program:
+    """Call sites for every function in the table + memoized
+    propagation passes."""
+
+    def __init__(self, table: SymbolTable):
+        self.table = table
+        self.calls: dict[str, list[CallSite]] = {}
+        self.stats = {"resolved": 0, "unresolved": 0, "external": 0,
+                      "blocking": 0}
+        self._blocking_memo: dict[str, list | None] = {}
+        self._cycle_cut = False
+        for fi in table.functions.values():
+            self.calls[fi.qual] = self._extract(fi)
+
+    # -- extraction -----------------------------------------------------
+    def _extract(self, fi: FunctionInfo) -> list[CallSite]:
+        self._harvest_var_types(fi)
+        sites = []
+        for node in iter_own_nodes(fi.node):
+            if isinstance(node, ast.Call):
+                sites.append(self._classify(fi, node))
+        sites.sort(key=lambda s: s.lineno)
+        for s in sites:
+            self.stats[s.kind] += 1
+        return sites
+
+    def _harvest_var_types(self, fi: FunctionInfo) -> None:
+        args = fi.node.args
+        if not isinstance(fi.node, ast.Lambda):
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                chain = _annotation_chain(a.annotation)
+                if chain is None:
+                    continue
+                ci = self.table.resolve_class_chain(fi, chain)
+                if ci is not None:
+                    fi.var_types[a.arg] = ci.qual
+        for node in iter_own_nodes(fi.node):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                ci = self.table.resolve_class_chain(
+                    fi, chain_of(node.value.func))
+                if ci is not None:
+                    fi.var_types[node.targets[0].id] = ci.qual
+
+    def _classify(self, fi: FunctionInfo, node: ast.Call) -> CallSite:
+        chain = chain_of(node.func)
+        what = classify_blocking(self.table, fi, chain)
+        if what:
+            return CallSite(node, chain, "blocking", what=what)
+        if chain and chain[-1] in EXECUTOR_TAILS:
+            # the thunk argument runs off-loop; the dispatching call
+            # itself is event-loop machinery
+            return CallSite(node, chain, "external")
+        kind, target = self._resolve(fi, chain)
+        return CallSite(node, chain, kind, target=target,
+                        what="" if target else
+                        ".".join(chain) if chain else "<dynamic>")
+
+    # -- resolution -----------------------------------------------------
+    def _resolve(self, fi: FunctionInfo, chain):
+        table = self.table
+        if not chain:
+            return "unresolved", None
+        head = chain[0]
+        if head == "<const>":
+            return "external", None     # literal receivers are builtin
+        if head == "<call>":
+            # get_running_loop().x / self._volume(vid).write(n): the
+            # receiver is a call result we do not type. Known-external
+            # tails stay external; the rest is honestly unresolved.
+            if chain[-1] in BUILTIN_METHODS:
+                return "external", None
+            return "unresolved", None
+        if chain[-1] in BUILTIN_METHODS and len(chain) > 1:
+            return "external", None
+        if head in ("self", "cls") and fi.cls is not None:
+            if len(chain) == 2:
+                m = table.lookup_method(fi.cls, chain[1])
+                return ("resolved", m) if m else ("unresolved", None)
+            if len(chain) == 3:
+                tq = fi.cls.attr_types.get(chain[1])
+                ci = table.class_by_qual(tq) if tq else None
+                if ci is not None:
+                    m = table.lookup_method(ci, chain[2])
+                    if m:
+                        return "resolved", m
+            return "unresolved", None
+        if head in fi.var_types and len(chain) == 2:
+            ci = table.class_by_qual(fi.var_types[head])
+            if ci is not None:
+                m = table.lookup_method(ci, chain[1])
+                if m:
+                    return "resolved", m
+            return "unresolved", None
+        mod = fi.module
+        if head in mod.functions and len(chain) == 1:
+            return "resolved", mod.functions[head]
+        if head in mod.classes:
+            return self._resolve_via_class(mod.classes[head], chain[1:])
+        if head in mod.from_symbols:
+            return self._resolve_from_symbol(fi, chain)
+        if head in mod.imports:
+            return self._resolve_import(fi, chain)
+        if head in _BUILTIN_NAMES:
+            return "external", None
+        if head in EXTERNAL_MODULES:
+            return "external", None
+        return "unresolved", None
+
+    def _resolve_via_class(self, ci, rest):
+        if len(rest) == 0:                      # Ctor()
+            init = self.table.lookup_method(ci, "__init__")
+            return "resolved", init             # init may be None
+        if len(rest) == 1:                      # ClassName.method()
+            m = self.table.lookup_method(ci, rest[0])
+            return ("resolved", m) if m else ("unresolved", None)
+        return "unresolved", None
+
+    def _resolve_from_symbol(self, fi: FunctionInfo, chain):
+        mod = fi.module
+        base, sym = mod.from_symbols[chain[0]]
+        top = (base or sym).split(".")[0]
+        target_mod = self.table.modules.get(base) if base else None
+        if target_mod is not None:
+            if sym in target_mod.functions and len(chain) == 1:
+                return "resolved", target_mod.functions[sym]
+            if sym in target_mod.classes:
+                return self._resolve_via_class(
+                    target_mod.classes[sym], chain[1:])
+        sub = self.table.modules.get(f"{base}.{sym}" if base else sym)
+        if sub is not None:                     # from pkg import module
+            return self._resolve_in_module(sub, chain[1:])
+        if top in EXTERNAL_MODULES:
+            return "external", None
+        return "unresolved", None
+
+    def _resolve_import(self, fi: FunctionInfo, chain):
+        dotted = fi.module.imports[chain[0]]
+        if dotted.split(".")[0] in EXTERNAL_MODULES:
+            return "external", None
+        parts = dotted.split(".") + list(chain[1:])
+        for i in range(len(parts) - 1, 0, -1):
+            mod = self.table.modules.get(".".join(parts[:i]))
+            if mod is not None:
+                return self._resolve_in_module(mod, tuple(parts[i:]))
+        return "unresolved", None
+
+    def _resolve_in_module(self, mod, rest):
+        if len(rest) == 1 and rest[0] in mod.functions:
+            return "resolved", mod.functions[rest[0]]
+        if rest and rest[0] in mod.classes:
+            return self._resolve_via_class(mod.classes[rest[0]],
+                                           rest[1:])
+        return "unresolved", None
+
+    # -- propagation ----------------------------------------------------
+    def blocking_path(self, fi: FunctionInfo,
+                      _stack: set | None = None) -> list | None:
+        """For a SYNC function: the first chain of (qual, lineno,
+        what) steps reaching a blocking primitive through resolved
+        sync calls, or None. Async callees terminate the walk (their
+        own async roots are analyzed separately); memoized; cycles
+        terminate via the in-progress stack."""
+        if fi.is_async or fi.qual in SANCTIONED_SINKS:
+            return None
+        memo = self._blocking_memo
+        if fi.qual in memo:
+            return memo[fi.qual]
+        stack = _stack if _stack is not None else set()
+        if fi.qual in stack:
+            self._cycle_cut = True
+            return None
+        stack.add(fi.qual)
+        outer_cut = self._cycle_cut
+        self._cycle_cut = False
+        result = None
+        for site in self.calls.get(fi.qual, ()):
+            if site.kind == "blocking":
+                result = [(fi.qual, site.lineno, site.what)]
+                break
+            if site.kind == "resolved" and site.target is not None \
+                    and not site.target.is_async \
+                    and not site.target.is_generator:
+                sub = self.blocking_path(site.target, stack)
+                if sub is not None:
+                    result = [(fi.qual, site.lineno,
+                               site.target.qual)] + sub
+                    break
+        stack.discard(fi.qual)
+        # A concrete path is valid no matter what the stack suppressed
+        # (suppression only removes paths). A None computed after a
+        # callee walk was cut at an in-stack node depends on THIS
+        # query's stack — memoizing it would permanently hide a cycle
+        # member's real path from later queries via other callers.
+        if result is not None or not self._cycle_cut:
+            memo[fi.qual] = result
+        self._cycle_cut = self._cycle_cut or outer_cut
+        return result
+
+    def unresolved_rate(self) -> float:
+        cand = self.stats["resolved"] + self.stats["unresolved"]
+        return (self.stats["unresolved"] / cand) if cand else 0.0

@@ -15,11 +15,10 @@ from dataclasses import dataclass, field
 
 from . import baseline as baseline_mod
 from .baseline import Baseline
-from .core import Finding
-from .rules import ALL_RULE_CLASSES, make_rules
+from .core import REPO, Finding
+from .rules import (ALL_RULE_CLASSES, TESTS_ENFORCED_RULE_IDS,
+                    make_rules)
 
-REPO = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
 DEFAULT_PATHS = ["seaweedfs_tpu", "tools"]
 
 
@@ -32,9 +31,12 @@ class LintResult:
     @property
     def problems(self) -> list[Finding]:
         """Findings that actually gate: not suppressed, not
-        grandfathered."""
+        grandfathered, not advisory (unresolved-call reports but
+        never fails the run — its ceiling lives in
+        tests/test_callgraph.py)."""
         return [f for f in self.findings
-                if not f.suppressed and not f.baselined]
+                if not f.suppressed and not f.baselined
+                and not f.advisory]
 
     def summary(self) -> dict[str, int]:
         counts: dict[str, int] = {}
@@ -84,7 +86,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help=f"files/dirs to lint (default: "
                         f"{' '.join(DEFAULT_PATHS)})")
     p.add_argument("--select", default="",
-                   help="comma-separated rule ids to run (default all)")
+                   help="comma-separated rule ids to run (default "
+                        "all); the preset 'tests-enforced' expands to "
+                        "rules.TESTS_ENFORCED_RULE_IDS so ci.sh and "
+                        "the tests share one source of truth")
     p.add_argument("--ignore", default="",
                    help="comma-separated rule ids to skip")
     p.add_argument("--format", choices=("text", "json"),
@@ -105,7 +110,59 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--show-suppressed", action="store_true",
                    help="also print suppressed/baselined findings")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="lint only files changed vs the git ref "
+                        "(default HEAD: staged+unstaged+untracked) — "
+                        "the sub-second pre-commit loop; phase 2 "
+                        "still resolves over the WHOLE tree but "
+                        "reports only into changed files")
+    p.add_argument("--jobs", default="1", metavar="N",
+                   help="phase-1 process-pool width; 'auto' = cpu "
+                        "count (output stays path-sorted and "
+                        "deterministic regardless)")
+    p.add_argument("--stats", action="store_true",
+                   help="print call-resolution stats (the "
+                        "unresolved-call precision metric) after "
+                        "linting")
     return p
+
+
+def changed_files(ref: str, scope_paths: list[str],
+                  repo: str = REPO) -> list[str]:
+    """Files changed vs `ref` (plus untracked), filtered to .py under
+    the scanned paths — plus changed .md anywhere, so docs-drift can
+    report into an edited catalog. Deleted files are skipped (nothing
+    to parse). Raises RuntimeError when git fails — a typo'd ref or a
+    shallow checkout must NOT silently lint nothing and pass."""
+    import subprocess
+    out: list[str] = []
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, cwd=repo, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise RuntimeError(f"--changed: {' '.join(cmd)!r} "
+                               f"failed: {e}") from e
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"--changed: {' '.join(cmd)!r} exited "
+                f"{proc.returncode}: {proc.stderr.strip()}")
+        out += proc.stdout.splitlines()
+    scopes = [os.path.relpath(os.path.abspath(p), repo)
+              .replace(os.sep, "/") for p in scope_paths]
+    picked: list[str] = []
+    for rel in sorted(dict.fromkeys(out)):
+        if not rel.endswith((".py", ".md")):
+            continue
+        if not any(s in (".", "") or rel == s or rel.startswith(s + "/")
+                   for s in scopes) and not rel.endswith(".md"):
+            continue
+        path = os.path.join(repo, rel)
+        if os.path.isfile(path):
+            picked.append(path)
+    return picked
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -116,15 +173,44 @@ def main(argv: list[str] | None = None) -> int:
     paths = args.paths or [os.path.join(REPO, p)
                            for p in DEFAULT_PATHS]
     select = [s for s in args.select.split(",") if s]
+    select = [r for s in select
+              for r in (TESTS_ENFORCED_RULE_IDS
+                        if s == "tests-enforced" else (s,))]
     ignore = [s for s in args.ignore.split(",") if s]
     try:
         rules = make_rules(select or None, ignore or None)
     except ValueError as e:
         print(f"weedlint: {e}", file=sys.stderr)
         return 2
+    try:
+        jobs = (os.cpu_count() or 1) if args.jobs == "auto" \
+            else int(args.jobs)
+    except ValueError:
+        print(f"weedlint: --jobs wants an integer or 'auto', got "
+              f"{args.jobs!r}", file=sys.stderr)
+        return 2
+
+    restrict_rels = None
+    if args.changed is not None:
+        from .core import relpath
+        try:
+            changed = changed_files(args.changed, paths, repo=REPO)
+        except RuntimeError as e:
+            print(f"weedlint: {e}", file=sys.stderr)
+            return 2
+        restrict_rels = {relpath(p) for p in changed}
+        paths = [p for p in changed if p.endswith(".py")]
+        if not restrict_rels:
+            print(f"weedlint: clean (nothing changed vs "
+                  f"{args.changed})")
+            return 0
+
     from .core import run_paths
     check_unused = not select and not ignore
-    findings = run_paths(paths, rules, check_unused=check_unused)
+    stats: dict = {}
+    findings = run_paths(paths, rules, check_unused=check_unused,
+                         jobs=jobs, restrict_rels=restrict_rels,
+                         stats_out=stats)
 
     baseline_path = "-" if args.no_baseline else args.baseline
     if args.write_baseline:
@@ -188,6 +274,14 @@ def main(argv: list[str] | None = None) -> int:
             print(f"weedlint: {total} finding(s): {' '.join(parts)}")
         else:
             print("weedlint: clean")
+    if args.stats and stats:
+        cand = stats.get("resolved", 0) + stats.get("unresolved", 0)
+        print(f"call resolution: {stats.get('resolved', 0)} resolved, "
+              f"{stats.get('unresolved', 0)} unresolved, "
+              f"{stats.get('external', 0)} external, "
+              f"{stats.get('blocking', 0)} blocking primitives "
+              f"({stats.get('unresolved_rate', 0.0):.1%} of {cand} "
+              f"candidates unresolved)")
     if args.report_only:
         return 0
     return 0 if result.ok else 1

@@ -42,6 +42,7 @@ class Finding:
     suppressed: bool = False
     suppress_reason: str = ""
     baselined: bool = False
+    advisory: bool = False      # reported but never gates exit code
 
     def render(self) -> str:
         return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
@@ -50,7 +51,8 @@ class Finding:
         return {"path": self.rel, "line": self.line, "rule": self.rule,
                 "message": self.message, "code": self.code,
                 "suppressed": self.suppressed,
-                "baselined": self.baselined}
+                "baselined": self.baselined,
+                "advisory": self.advisory}
 
 
 class Rule:
@@ -67,6 +69,8 @@ class Rule:
     example: str = ""
     fix: str = ""
     node_types: tuple = ()
+    phase: int = 1              # 1 = per-file walk, 2 = whole-program
+    advisory: bool = False      # advisory rules never gate (exit 0)
 
     def begin(self, ctx: "FileContext") -> None:  # pragma: no cover
         pass
@@ -76,6 +80,21 @@ class Rule:
 
     def finish(self, ctx: "FileContext") -> None:  # pragma: no cover
         pass
+
+
+class ProgramRule(Rule):
+    """Base class for a phase-2 (whole-program) pass: runs once per
+    invocation over the shared symbol table + call graph instead of
+    once per file. ``run`` reports through a ProgramReporter (see
+    program.py), which anchors findings, fills source lines and
+    filters to the scanned file set (unless ``report_everywhere``,
+    e.g. docs-drift findings landing in .md files)."""
+
+    phase = 2
+    report_everywhere = False
+
+    def run(self, program, reporter) -> None:
+        raise NotImplementedError
 
 
 class FileContext:
@@ -157,13 +176,12 @@ def relpath(path: str) -> str:
     return path.replace(os.sep, "/")
 
 
-def run_file(path: str, rules: list[Rule], *,
-             src: str | None = None,
-             check_unused: bool = True) -> list[Finding]:
-    """Lint one file with `rules`: parse once, one walk, dispatch by
-    node type, then apply suppression comments. Returns every finding
-    (suppressed ones included, flagged) so callers can choose between
-    enforcement and report-only."""
+def analyze_file(path: str, rules: list[Rule], *,
+                 src: str | None = None):
+    """Phase-1 walk of one file: parse once, dispatch by node type,
+    mark (but never judge) suppressions. Returns ``(findings, sups)``
+    — the unused-suppression verdict is deferred to the caller, which
+    may still match sups against phase-2 findings."""
     if src is None:
         with open(path, encoding="utf-8") as f:
             src = f.read()
@@ -172,10 +190,12 @@ def run_file(path: str, rules: list[Rule], *,
     except SyntaxError as e:
         return [Finding(path=path, rel=relpath(path), line=e.lineno or 1,
                         rule="syntax-error",
-                        message=f"syntax error: {e.msg}")]
+                        message=f"syntax error: {e.msg}")], []
     ctx = FileContext(path, src, tree)
     dispatch: dict[type, list[Rule]] = {}
     for r in rules:
+        if r.phase != 1:
+            continue
         r.begin(ctx)
         for t in r.node_types:
             dispatch.setdefault(t, []).append(r)
@@ -183,10 +203,24 @@ def run_file(path: str, rules: list[Rule], *,
         for r in dispatch.get(type(node), ()):
             r.visit(ctx, node)
     for r in rules:
-        r.finish(ctx)
-    suppress.apply(ctx, check_unused=check_unused)
+        if r.phase == 1:
+            r.finish(ctx)
+    sups = suppress.apply(ctx, check_unused=False)
     ctx.findings.sort(key=lambda f: (f.line, f.rule))
-    return ctx.findings
+    return ctx.findings, sups
+
+
+def run_file(path: str, rules: list[Rule], *,
+             src: str | None = None,
+             check_unused: bool = True) -> list[Finding]:
+    """Lint one file with the phase-1 `rules`. Returns every finding
+    (suppressed ones included, flagged) so callers can choose between
+    enforcement and report-only."""
+    findings, sups = analyze_file(path, rules, src=src)
+    if check_unused:
+        findings += suppress.unused_findings(path, relpath(path), sups)
+        findings.sort(key=lambda f: (f.line, f.rule))
+    return findings
 
 
 def iter_py_files(paths: list[str]):
@@ -203,9 +237,74 @@ def iter_py_files(paths: list[str]):
                     yield os.path.join(root, name)
 
 
+def _analyze_one(args):
+    """Process-pool entry point for parallel phase 1 (must be a
+    module-level function to pickle)."""
+    path, rule_ids = args
+    from .rules import make_rules
+    return path, analyze_file(path, make_rules(rule_ids or None))
+
+
 def run_paths(paths: list[str], rules: list[Rule], *,
-              check_unused: bool = True) -> list[Finding]:
+              check_unused: bool = True, jobs: int = 1,
+              restrict_rels: set[str] | None = None,
+              stats_out: dict | None = None) -> list[Finding]:
+    """Lint `paths`: phase 1 over every file (optionally across a
+    process pool), then the phase-2 whole-program passes, then one
+    suppression/unused verdict over the union. Output is
+    deterministic: findings sorted by (path, line, rule) regardless
+    of pool scheduling.
+
+    ``restrict_rels`` is --changed mode: phase 2 still builds the
+    whole-tree symbol table, but every finding (docs-drift's .md
+    anchors included) must land in the restricted set. ``stats_out``
+    receives the call-resolution counters when phase 2 runs."""
+    files = sorted(dict.fromkeys(iter_py_files(paths)))
+    file_rules = [r for r in rules if r.phase == 1]
+    program_rules = [r for r in rules if r.phase == 2]
+
+    per_file: dict[str, tuple[list[Finding], list]] = {}
+    if jobs > 1 and len(files) > 1:
+        from concurrent.futures import ProcessPoolExecutor
+        from concurrent.futures.process import BrokenProcessPool
+        try:
+            ids = [r.id for r in rules]
+            with ProcessPoolExecutor(max_workers=jobs) as pool:
+                for path, result in pool.map(
+                        _analyze_one, [(f, ids) for f in files],
+                        chunksize=8):
+                    per_file[path] = result
+        except (OSError, ImportError, BrokenProcessPool):
+            # no fork on this host / worker died mid-run: serial
+            per_file = {}
+    if not per_file:
+        for f in files:
+            per_file[f] = analyze_file(f, file_rules)
+
     findings: list[Finding] = []
-    for p in iter_py_files(paths):
-        findings += run_file(p, rules, check_unused=check_unused)
+    for f in files:
+        findings += per_file[f][0]
+
+    if program_rules:
+        from .program import run_program
+        rel_to_path = {relpath(f): f for f in files}
+        prog_findings = run_program(program_rules, paths,
+                                    scanned_rels=set(rel_to_path),
+                                    restrict_rels=restrict_rels,
+                                    stats_out=stats_out)
+        # phase-2 findings ride the same per-line suppressions
+        by_rel: dict[str, list[Finding]] = {}
+        for pf in prog_findings:
+            by_rel.setdefault(pf.rel, []).append(pf)
+        for rel, group in by_rel.items():
+            path = rel_to_path.get(rel)
+            if path is not None:
+                suppress.mark(group, per_file[path][1])
+        findings += prog_findings
+
+    if check_unused:
+        for f in files:
+            findings += suppress.unused_findings(
+                f, relpath(f), per_file[f][1])
+    findings.sort(key=lambda x: (x.rel, x.line, x.rule))
     return findings

@@ -1,0 +1,129 @@
+"""Phase-2 driver: build the whole-program symbol table + call graph
+once, run every ProgramRule over it, anchor the findings.
+
+The symbol table always covers the WHOLE tree (plus any scanned paths
+outside it): a `--changed`/subpath run still resolves calls across
+every module — only the *reporting* is filtered to the scanned files.
+A scan of a fixture tree outside the repo builds its table from the
+fixture roots alone, so tests stay hermetic.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .callgraph import Program
+from .core import REPO, Finding, ProgramRule, relpath
+from .symbols import SymbolTable
+
+DEFAULT_ROOTS = [os.path.join(REPO, "seaweedfs_tpu"),
+                 os.path.join(REPO, "tools")]
+
+
+def program_roots(paths: list[str]) -> list[str]:
+    """Symbol-table roots for a scan of `paths`: the enforced-tree
+    roots whenever the scan touches the repo (so cross-module
+    resolution always sees everything), plus any scanned directories
+    outside them; a fully-out-of-repo scan (fixtures) uses only its
+    own roots."""
+    if not paths:               # --changed with only .md edits: the
+        return list(DEFAULT_ROOTS)   # whole-tree table still resolves
+    roots: list[str] = []
+    in_repo = False
+    for p in paths:
+        ap = os.path.abspath(p)
+        if not os.path.isdir(ap):
+            ap = os.path.dirname(ap)
+        if ap == REPO or ap.startswith(REPO + os.sep):
+            in_repo = True
+            if any(ap == d or ap.startswith(d + os.sep)
+                   for d in DEFAULT_ROOTS):
+                continue
+            if ap == REPO:
+                # the repo root itself must never BE a root: module
+                # quals would gain the checkout dir's name as a prefix
+                # ('repo.seaweedfs_tpu....'), silently defeating every
+                # qual-keyed table (SANCTIONED_SINKS, ...). The
+                # package roots are covered via in_repo; sibling
+                # top-level dirs (tests/, ...) become their own roots.
+                for entry in sorted(os.listdir(ap)):
+                    sub = os.path.join(ap, entry)
+                    if os.path.isdir(sub) and sub not in DEFAULT_ROOTS \
+                            and not entry.startswith("."):
+                        roots.append(sub)
+                continue
+        roots.append(ap)
+    if in_repo:
+        roots = DEFAULT_ROOTS + roots
+    out: list[str] = []
+    for r in roots:                       # drop nested/duplicate roots
+        if not any(other != r and (r == other
+                   or r.startswith(other + os.sep))
+                   for other in roots) and r not in out:
+            out.append(r)
+    return out
+
+
+class ProgramReporter:
+    """Collects phase-2 findings: fills the source line from the
+    symbol table (or the file itself for non-.py anchors like docs),
+    and filters to the scanned file set unless the rule opts out."""
+
+    def __init__(self, table: SymbolTable, scanned_rels: set[str],
+                 restrict_rels: set[str] | None = None):
+        self.table = table
+        self.scanned_rels = scanned_rels
+        self.restrict_rels = restrict_rels
+        self.findings: list[Finding] = []
+        self._doc_lines: dict[str, list[str]] = {}
+
+    def _source_line(self, rel: str, line: int) -> str:
+        mod = self.table.by_rel.get(rel)
+        if mod is not None:
+            lines = mod.src.splitlines()
+        else:
+            if rel not in self._doc_lines:
+                path = os.path.join(REPO, rel)
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        self._doc_lines[rel] = f.read().splitlines()
+                except OSError:
+                    self._doc_lines[rel] = []
+            lines = self._doc_lines[rel]
+        if 1 <= line <= len(lines):
+            return lines[line - 1].strip()
+        return ""
+
+    def report(self, rule: ProgramRule, rel: str, line: int,
+               message: str, *, path: str | None = None) -> None:
+        if not rule.report_everywhere \
+                and self.scanned_rels \
+                and rel not in self.scanned_rels:
+            return
+        if self.restrict_rels is not None \
+                and rel not in self.restrict_rels:
+            return              # --changed: report into changed files only
+        mod = self.table.by_rel.get(rel)
+        self.findings.append(Finding(
+            path=path or (mod.path if mod else rel), rel=rel,
+            line=line, rule=rule.id, message=message,
+            advisory=rule.advisory,
+            code=self._source_line(rel, line)))
+
+
+def run_program(program_rules: list[ProgramRule], paths: list[str],
+                *, scanned_rels: set[str],
+                restrict_rels: set[str] | None = None,
+                table: SymbolTable | None = None,
+                stats_out: dict | None = None) -> list[Finding]:
+    if table is None:
+        table = SymbolTable.build(program_roots(paths))
+    program = Program(table)
+    if stats_out is not None:
+        stats_out.update(program.stats)
+        stats_out["unresolved_rate"] = program.unresolved_rate()
+    reporter = ProgramReporter(table, scanned_rels, restrict_rels)
+    for rule in program_rules:
+        rule.run(program, reporter)
+    reporter.findings.sort(key=lambda f: (f.rel, f.line, f.rule))
+    return reporter.findings

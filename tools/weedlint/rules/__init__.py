@@ -6,12 +6,17 @@ from __future__ import annotations
 from .asynchrony import (AwaitInLockRule, BlockingIoRule,
                          LockAcquireRule, OrphanTaskRule)
 from .cache import CacheInvalidateRule, FailpointSiteRule
+from .drift import DocsDriftRule
 from .exceptions import SilentExceptRule
 from .executor import ExecutorCtxRule
+from .interproc import (LockOrderRule, TimeoutDisciplineRule,
+                        TransitiveBlockingRule,
+                        TransitiveOrphanSpanRule, UnresolvedCallRule)
 from .metrics import MetricHelpRule, MetricNameRule, SpanFinishRule
 from .resources import ResourceWithRule
 
 ALL_RULE_CLASSES = (
+    # phase 1: one shared walk per file
     SilentExceptRule,
     MetricNameRule,
     MetricHelpRule,
@@ -24,6 +29,13 @@ ALL_RULE_CLASSES = (
     CacheInvalidateRule,
     FailpointSiteRule,
     ExecutorCtxRule,
+    # phase 2: whole-program, over the shared symbol table + call graph
+    TransitiveBlockingRule,
+    LockOrderRule,
+    TimeoutDisciplineRule,
+    TransitiveOrphanSpanRule,
+    UnresolvedCallRule,
+    DocsDriftRule,
 )
 
 # findings the framework itself emits (no Rule class walks for these)
@@ -31,6 +43,15 @@ META_RULE_IDS = ("suppress-format", "unused-suppression",
                  "syntax-error")
 
 ALL_RULE_IDS = tuple(c.id for c in ALL_RULE_CLASSES)
+
+# rules whose findings report but never gate (exit code stays 0)
+ADVISORY_RULE_IDS = tuple(c.id for c in ALL_RULE_CLASSES if c.advisory)
+
+# the subset safe to ENFORCE over tests/ (fixtures legitimately write
+# blocking I/O, unclosed sessions-on-purpose, and lock inversions to
+# feed the rules; exception/task/fd hygiene applies to test code too)
+TESTS_ENFORCED_RULE_IDS = ("silent-except", "orphan-task",
+                           "resource-with")
 
 # the three passes the original tools/lint_robustness.py shipped —
 # its shim keeps exactly this behavior

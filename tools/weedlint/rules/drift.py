@@ -1,0 +1,101 @@
+"""docs-drift: the five hand-maintained catalogs must match the code.
+
+Every PR since the flag/metric/event/failpoint tables were written has
+edited the code side without a machine check on the doc side. This
+pass diffs artifacts.py's AST extraction against the markdown catalogs
+in both directions:
+
+- **undocumented** — a flag/metric/event type/failpoint site/debug
+  route that exists in the code but appears nowhere in the scanned
+  catalogs (README.md, OBSERVABILITY.md, ROBUSTNESS.md, EC.md);
+  anchored at the defining code line.
+- **dead** — a catalog entry naming nothing in the code (the flag was
+  renamed, the site unplanted, the metric dropped); anchored at the
+  doc line, so the finding lands where the fix goes.
+
+Doc anchors can't carry suppression comments — drift is always fixed
+in-tree, never excused.
+"""
+
+from __future__ import annotations
+
+from .. import artifacts
+from ..core import ProgramRule
+
+
+class DocsDriftRule(ProgramRule):
+    id = "docs-drift"
+    title = "code and catalog docs disagree on a name"
+    rationale = ("the flag, metric, journal-event, failpoint and "
+                 "/debug-route tables in README/OBSERVABILITY/"
+                 "ROBUSTNESS/EC are the operator's interface to the "
+                 "cluster, and they are four PRs deep in hand edits "
+                 "with no machine check — a site the chaos runbook "
+                 "names but nobody plants, or a flag the code grew "
+                 "that no doc admits, both rot silently. This pass "
+                 "extracts each family from the AST and diffs both "
+                 "directions against the catalogs.")
+    example = ("ROBUSTNESS.md: | `replication.s3` | ... |   # no "
+               "failpoints.fail('replication.s3') anywhere in the tree")
+    fix = ("undocumented: add the name to its catalog table; dead: "
+           "delete the row (or re-plant the code it promised)")
+    report_everywhere = True
+
+    # (family, mention-check, claim-check) wiring
+    def run(self, program, reporter) -> None:
+        # only meaningful over a tree that carries the package CLI —
+        # diffing the repo's catalogs against a fixture snippet (or an
+        # empty table) would report every claim as dead
+        if not any(m.rel.endswith("seaweedfs_tpu/cli.py")
+                   for m in program.table.modules.values()):
+            return
+        code = artifacts.extract_code(program.table)
+        # module attributes, not defaults: tests point REPO/DOC_FILES
+        # at fixture catalogs
+        docs = artifacts.extract_docs(artifacts.REPO,
+                                      artifacts.DOC_FILES)
+        catalogs = "/".join(artifacts.DOC_FILES)
+
+        def undocumented(family: str, items, documented) -> None:
+            for name, art in sorted(items.items()):
+                if not documented(name):
+                    reporter.report(
+                        self, art.rel, art.line,
+                        f"{family} {name!r} exists in code but none "
+                        f"of {catalogs} documents it — add it to the "
+                        f"catalog table")
+
+        def dead(family: str, claims, live) -> None:
+            seen = set()
+            for c in claims:
+                if c.name in seen or live(c.name):
+                    continue
+                seen.add(c.name)
+                reporter.report(
+                    self, c.rel, c.line,
+                    f"{family} {c.name!r} is documented here but the "
+                    f"code defines no such name — delete the entry or "
+                    f"restore the code it promises")
+
+        undocumented("flag", code.flags,
+                     lambda n: n in docs.flag_mentions)
+        undocumented("metric", code.metrics,
+                     lambda n: artifacts.metric_documented(
+                         n, docs.metric_mentions))
+        undocumented("event type", code.events,
+                     lambda n: n in docs.event_mentions)
+        undocumented("failpoint site", code.failpoints,
+                     lambda n: n in docs.failpoint_mentions)
+        undocumented("debug route", code.routes,
+                     lambda n: n in docs.route_mentions)
+
+        dead("flag", docs.flag_claims,
+             lambda n: n in code.flags)
+        dead("metric", [c for c in docs.metric_claims],
+             lambda n: artifacts.metric_claim_live(n, code.metrics))
+        dead("journal event type", docs.event_claims,
+             lambda n: n in code.events)
+        dead("failpoint site", docs.failpoint_claims,
+             lambda n: n in code.failpoints)
+        dead("debug route", docs.route_claims,
+             lambda n: n in code.routes)

@@ -1,0 +1,722 @@
+"""Phase-2 rules: properties that only hold (or break) across
+function and file boundaries.
+
+Each pass propagates one per-function fact over the call graph:
+
+* transitive-blocking — "does this sync function (or anything it
+  calls inline) hit a blocking primitive?" propagated up to every
+  async caller that isn't separated from it by an executor boundary.
+* lock-order          — per-function "locks acquired (transitively)"
+  sets; acquiring B while holding A adds edge A→B; a cycle in the
+  merged edge graph is a potential deadlock.
+* timeout-discipline  — every outbound aiohttp/socket/pool call must
+  carry an explicit timeout, traced through wrapper helpers that
+  forward a `timeout=None` parameter.
+* transitive-orphan-span — a span started here and finished in a
+  callee must provably finish on some path of that callee (or the
+  ownership must visibly move elsewhere).
+* unresolved-call     — the advisory precision diagnostic: every call
+  the bounded resolver gave up on, so the callgraph's blind spots are
+  measurable (and ceilinged by tests/test_callgraph.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..callgraph import Program, iter_own_nodes
+from ..core import ProgramRule
+from ..symbols import FunctionInfo, chain_of
+from .asynchrony import LOCKISH_RE
+from .cache import _HTTP_VERBS
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _short(qual: str) -> str:
+    """seaweedfs_tpu.storage.store.Store.write -> store.Store.write"""
+    parts = qual.split(".")
+    return ".".join(parts[-3:]) if len(parts) > 3 else qual
+
+
+class TransitiveBlockingRule(ProgramRule):
+    id = "transitive-blocking"
+    title = "blocking I/O reachable from async def through sync calls"
+    rationale = ("phase 1's blocking-io rule sees one file: a sync "
+                 "helper that does os.pread three calls below an "
+                 "`async def` stalls the event loop exactly as hard, "
+                 "but no single-file walk can see the chain. This "
+                 "pass propagates 'reaches a blocking primitive' over "
+                 "the call graph and reports at the async caller's "
+                 "call site; executor boundaries "
+                 "(tracing.run_in_executor / loop.run_in_executor / "
+                 "to_thread) terminate the walk — thunks run off the "
+                 "loop.")
+    example = ("async def h(req):\n"
+               "    return self._load(req.vid)   # sync\n"
+               "def _load(self, vid):\n"
+               "    return _read_meta(vid)       # sync\n"
+               "def _read_meta(vid):\n"
+               "    return open(path(vid)).read()  # 3 deep: stalls "
+               "the loop")
+    fix = ("route the outermost sync call through "
+           "tracing.run_in_executor, or make the chain async down to "
+           "the primitive")
+
+    def run(self, program: Program, reporter) -> None:
+        for fi in program.table.functions.values():
+            if not fi.is_async:
+                continue
+            for site in program.calls.get(fi.qual, ()):
+                if site.kind != "resolved" or site.target is None \
+                        or site.target.is_async \
+                        or site.target.is_generator:
+                    continue
+                path = program.blocking_path(site.target)
+                if path is None:
+                    continue
+                what = path[-1][2]
+                chain = " -> ".join(_short(q) for q, _, _ in path)
+                reporter.report(
+                    self, fi.rel, site.lineno,
+                    f"async {fi.name}() reaches blocking {what}() on "
+                    f"the event loop via {chain} — the whole chain "
+                    f"runs inline; route it through "
+                    f"tracing.run_in_executor")
+
+
+def _lock_identity(fi: FunctionInfo, expr: ast.AST) -> str | None:
+    """Stable cross-file identity for an acquired lock, or None when
+    the receiver can't be pinned (bare parameters alias anything —
+    guessing would fabricate deadlocks)."""
+    chain = chain_of(expr)
+    if not chain or not LOCKISH_RE.search(chain[-1]):
+        return None
+    if chain[0] == "self" and fi.cls is not None:
+        if len(chain) == 2:
+            return f"{fi.cls.qual}.{chain[1]}"
+        if len(chain) == 3:
+            tq = fi.cls.attr_types.get(chain[1])
+            if tq:
+                return f"{tq}.{chain[2]}"
+        return None
+    if len(chain) == 1 and chain[0] in fi.module.lock_names:
+        return f"{fi.module.name}.{chain[0]}"
+    if len(chain) == 2:
+        mod = fi.module
+        target = None
+        fs = mod.from_symbols.get(chain[0])
+        if fs:
+            target = f"{fs[0]}.{fs[1]}" if fs[0] else fs[1]
+        elif chain[0] in mod.imports:
+            target = mod.imports[chain[0]]
+        if target:
+            return f"{target}.{chain[1]}"
+        if chain[0] in fi.var_types:
+            return f"{fi.var_types[chain[0]]}.{chain[1]}"
+    return None
+
+
+class LockOrderRule(ProgramRule):
+    id = "lock-order"
+    title = "lock-order inversion across the call graph"
+    rationale = ("two code paths that acquire the same two locks in "
+                 "opposite orders deadlock the first time they "
+                 "interleave — and the two halves of the inversion "
+                 "are usually in different modules, invisible to any "
+                 "per-file pass. Each function's (transitive) lock "
+                 "acquisition set is propagated over the call graph; "
+                 "acquiring B anywhere under a held A adds edge A→B, "
+                 "and a cycle in the merged graph is a potential "
+                 "deadlock. Locks are identified by their owning "
+                 "class/module attribute; bare lock parameters are "
+                 "skipped (aliases would fabricate cycles).")
+    example = ("# store.py               # vacuum.py\n"
+               "with self._vol_lock:     with store._map_lock:\n"
+               "    self._map_lock...        store._vol_lock...")
+    fix = ("pick one global order for the two locks and acquire in "
+           "that order on every path (or collapse the critical "
+           "sections)")
+
+    def run(self, program: Program, reporter) -> None:
+        self._program = program
+        self._closure_memo: dict[str, set[str]] = {}
+        self._closure_cut = False
+        edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+        for fi in program.table.functions.values():
+            for node in iter_own_nodes(fi.node):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                for item in node.items:
+                    held = _lock_identity(fi, item.context_expr)
+                    if held is None:
+                        continue
+                    self._edges_under(fi, held, node, edges)
+        adj: dict[str, set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+        cyclic = _cyclic_nodes(adj)
+        for (a, b), (rel, line, via) in sorted(edges.items()):
+            if a in cyclic and b in cyclic and _reaches(adj, b, a):
+                via_txt = f" (via {via})" if via else ""
+                reporter.report(
+                    self, rel, line,
+                    f"lock-order inversion: acquires {_short(b)} "
+                    f"while holding {_short(a)}{via_txt}, and another "
+                    f"path acquires them in the opposite order — "
+                    f"potential deadlock; pick one global order")
+
+    def _edges_under(self, fi: FunctionInfo, held: str,
+                     with_node, edges) -> None:
+        """Locks acquired anywhere inside `with_node`'s body — nested
+        `with`s directly, call sites through their transitive
+        acquisition closure."""
+        program = self._program
+        sites = {s.node: s for s in program.calls.get(fi.qual, ())}
+        stack = list(with_node.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, _FUNC_NODES):
+                continue
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    inner = _lock_identity(fi, item.context_expr)
+                    if inner and inner != held:
+                        edges.setdefault(
+                            (held, inner), (fi.rel, node.lineno, ""))
+            if isinstance(node, ast.Call) and node in sites:
+                site = sites[node]
+                if site.kind == "resolved" and site.target is not None:
+                    for inner in self._closure(site.target):
+                        if inner != held:
+                            edges.setdefault(
+                                (held, inner),
+                                (fi.rel, site.lineno,
+                                 _short(site.target.qual)))
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _closure(self, fi: FunctionInfo,
+                 _stack: set | None = None) -> set[str]:
+        """Every lock identity `fi` may acquire, transitively."""
+        memo = self._closure_memo
+        if fi.qual in memo:
+            return memo[fi.qual]
+        stack = _stack if _stack is not None else set()
+        if fi.qual in stack:
+            self._closure_cut = True
+            return set()
+        stack.add(fi.qual)
+        outer_cut = self._closure_cut
+        self._closure_cut = False
+        out: set[str] = set()
+        for node in iter_own_nodes(fi.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ident = _lock_identity(fi, item.context_expr)
+                    if ident:
+                        out.add(ident)
+        for site in self._program.calls.get(fi.qual, ()):
+            if site.kind == "resolved" and site.target is not None:
+                out |= self._closure(site.target, stack)
+        stack.discard(fi.qual)
+        # A set computed after a callee walk was cut at an in-stack
+        # node is only a lower bound for THIS query's stack —
+        # memoizing it would permanently drop a cycle member's lock
+        # edges for every later caller.
+        if not self._closure_cut:
+            memo[fi.qual] = out
+        self._closure_cut = self._closure_cut or outer_cut
+        return out
+
+
+def _cyclic_nodes(adj: dict[str, set[str]]) -> set[str]:
+    """Nodes on some directed cycle (Tarjan SCCs of size > 1;
+    self-edges are excluded upstream by construction)."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on: set[str] = set()
+    stack: list[str] = []
+    out: set[str] = set()
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    out.update(scc)
+
+    for v in list(adj):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+def _reaches(adj: dict[str, set[str]], src: str, dst: str) -> bool:
+    seen = {src}
+    stack = [src]
+    while stack:
+        cur = stack.pop()
+        if cur == dst:
+            return True
+        for nxt in adj.get(cur, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append(nxt)
+    return False
+
+
+_SESSIONISH = re.compile(r"(?i)(sess|session|http|client|pool)$")
+_TIMEOUT_NAME = re.compile(r"(?i)(timeout|deadline)")
+TIMEOUT_SCOPE = ("seaweedfs_tpu/",)
+
+
+def _has_timeout_words(fn_node: ast.AST) -> bool:
+    for node in iter_own_nodes(fn_node):
+        name = ""
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.keyword):
+            name = node.arg or ""
+        if name and _TIMEOUT_NAME.search(name):
+            return True
+    return False
+
+
+def _params_with_defaults(fn_node) -> dict[str, "ast.AST | None"]:
+    """param name -> default node (None = required)."""
+    args = fn_node.args
+    out: dict[str, ast.AST | None] = {}
+    pos = args.posonlyargs + args.args
+    defaults = [None] * (len(pos) - len(args.defaults)) \
+        + list(args.defaults)
+    for a, d in zip(pos, defaults):
+        out[a.arg] = d
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        out[a.arg] = d
+    return out
+
+
+class TimeoutDisciplineRule(ProgramRule):
+    id = "timeout-discipline"
+    title = "outbound call without an explicit timeout"
+    rationale = ("an outbound HTTP/socket call with no timeout turns "
+                 "one wedged peer into a wedged caller — the PR-2 "
+                 "class where a single stalled upload held its slot "
+                 "for the old 120s session total. The site must carry "
+                 "`timeout=`, or its function/receiver must visibly "
+                 "own one (a ClientTimeout/…_timeout reference, or a "
+                 "pool whose constructor defaults it); a wrapper that "
+                 "merely forwards `timeout=None` passes the "
+                 "obligation to every caller, and this pass follows "
+                 "it there through the call graph.")
+    example = ("async def probe(self, url):\n"
+               "    async with self._http.get(url) as r:  # no "
+               "timeout anywhere in reach\n"
+               "        return r.status")
+    fix = ("pass timeout=aiohttp.ClientTimeout(...) (or the helper's "
+           "timeout parameter) at the call site")
+
+    def run(self, program: Program, reporter) -> None:
+        table = program.table
+        # pass 1: leaf sites + discover forwarding wrappers
+        wrappers: dict[str, str] = {}     # fi.qual -> timeout param
+        for fi in table.functions.values():
+            if not any(s in fi.rel for s in TIMEOUT_SCOPE):
+                continue
+            params = _params_with_defaults(fi.node)
+            fn_has_words = None           # computed lazily
+            for node in iter_own_nodes(fi.node):
+                if not (isinstance(node, ast.Call)
+                        and self._outbound(node)):
+                    continue
+                kw = next((k for k in node.keywords
+                           if k.arg == "timeout"), None)
+                if kw is not None:
+                    if isinstance(kw.value, ast.Constant) \
+                            and kw.value.value is None:
+                        reporter.report(
+                            self, fi.rel, node.lineno,
+                            f"outbound {self._label(node)} call with "
+                            f"explicit timeout=None — a wedged peer "
+                            f"wedges this caller forever")
+                    elif isinstance(kw.value, ast.Name) \
+                            and kw.value.id in params:
+                        d = params[kw.value.id]
+                        if d is None or (isinstance(d, ast.Constant)
+                                         and d.value is None):
+                            # required params force callers to choose;
+                            # a None default forwards the obligation
+                            if d is not None:
+                                wrappers[fi.qual] = kw.value.id
+                    continue
+                if fn_has_words is None:
+                    fn_has_words = _has_timeout_words(fi.node)
+                if fn_has_words or self._receiver_owns_timeout(
+                        program, fi, node):
+                    continue
+                reporter.report(
+                    self, fi.rel, node.lineno,
+                    f"outbound {self._label(node)} call with no "
+                    f"timeout in reach (no timeout= kwarg, no "
+                    f"timeout/deadline reference in "
+                    f"{fi.name}(), none owned by the receiver) — a "
+                    f"wedged peer wedges this caller forever")
+        # pass 2: callers of forwarding wrappers must supply one
+        for fi in table.functions.values():
+            if not any(s in fi.rel for s in TIMEOUT_SCOPE):
+                continue
+            fn_has_words = None
+            for site in program.calls.get(fi.qual, ()):
+                if site.kind != "resolved" or site.target is None \
+                        or site.target.qual not in wrappers:
+                    continue
+                param = wrappers[site.target.qual]
+                kw = next((k for k in site.node.keywords
+                           if k.arg == param), None)
+                if kw is not None and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None):
+                    continue
+                if fn_has_words is None:
+                    fn_has_words = _has_timeout_words(fi.node)
+                if fn_has_words:
+                    continue
+                reporter.report(
+                    self, fi.rel, site.lineno,
+                    f"call to {_short(site.target.qual)}() leaves its "
+                    f"{param}=None default — the wrapper forwards the "
+                    f"timeout obligation to this caller; pass "
+                    f"{param}=")
+
+    @staticmethod
+    def _outbound(node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _HTTP_VERBS:
+            chain = chain_of(f.value)
+            if chain and _SESSIONISH.search(chain[-1]):
+                return True
+        chain = chain_of(f)
+        if chain in (("socket", "create_connection"),):
+            return True
+        return bool(chain) and chain[-1] == "urlopen"
+
+    @staticmethod
+    def _label(node: ast.Call) -> str:
+        chain = chain_of(node.func)
+        return ".".join(chain[-2:]) if chain else "<dynamic>"
+
+    @staticmethod
+    def _attr_constructed_with_timeout(program: Program, owner_qual,
+                                       attr: str) -> bool:
+        """Was `self.<attr>` (following one @property hop) ever
+        assigned a call carrying `timeout=<non-None>` anywhere in
+        `owner_qual`'s MRO? That is receiver ownership: a session
+        built `tls.make_session(timeout=ClientTimeout(...))` bounds
+        every request it ever issues."""
+        owner = program.table.class_by_qual(owner_qual) \
+            if isinstance(owner_qual, str) else owner_qual
+        if owner is None:
+            return False
+        for ci in program.table.iter_mro(owner):
+            name = ci.prop_aliases.get(attr, attr)
+            if name in ci.timeout_attrs:
+                return True
+        return False
+
+    def _receiver_owns_timeout(self, program: Program,
+                               fi: FunctionInfo,
+                               node: ast.Call) -> bool:
+        """`self._http.get(...)` is fine when `_http` was constructed
+        with a session-level timeout (`tls.make_session(timeout=
+        ClientTimeout(total=60))`), and `self._pool.request(...)` when
+        the pool's own constructor defaults a timeout
+        (connpool.SyncHttpPool's shape). One @property hop
+        (`env.http` -> `_session`) is followed."""
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return False
+        chain = chain_of(f.value)
+        if not chain:
+            return False
+        ci = None
+        if chain[0] == "self" and fi.cls is not None and len(chain) == 2:
+            if self._attr_constructed_with_timeout(program, fi.cls,
+                                                   chain[1]):
+                return True
+            tq = fi.cls.attr_types.get(chain[1])
+            ci = program.table.class_by_qual(tq) if tq else None
+        elif len(chain) == 1 and chain[0] in fi.var_types:
+            ci = program.table.class_by_qual(fi.var_types[chain[0]])
+        elif len(chain) == 2 and chain[0] in fi.var_types:
+            # env.http.get(...): typed local/param, attribute receiver
+            return self._attr_constructed_with_timeout(
+                program, fi.var_types[chain[0]], chain[1])
+        if ci is None:
+            return False
+        init = program.table.lookup_method(ci, "__init__")
+        if init is None:
+            return False
+        for name, default in _params_with_defaults(init.node).items():
+            if _TIMEOUT_NAME.search(name) and default is not None \
+                    and not (isinstance(default, ast.Constant)
+                             and default.value is None):
+                return True
+        return False
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class TransitiveOrphanSpanRule(ProgramRule):
+    id = "transitive-orphan-span"
+    title = "span started here can leak through a callee"
+    rationale = ("a span that never finishes squats in the in-flight "
+                 "table forever and skews /debug/requests; phase 1's "
+                 "span-finish rule checks the finally discipline of "
+                 "an explicit finish, but a span handed to ANOTHER "
+                 "function must provably finish there — and 'the "
+                 "callee finishes it' is invisible to a per-file "
+                 "walk. This pass follows the handle: started and "
+                 "dropped, or transferred to a resolved callee that "
+                 "never finishes (nor re-transfers) it on any path, "
+                 "is a leak at the start site.")
+    example = ("sp = tracing.start('volume', 'read')\n"
+               "self._serve(req, sp)   # _serve never calls "
+               "sp.finish()")
+    fix = ("use `with tracing.start(...)`, or make the receiving "
+           "function finish the span in a finally")
+
+    def run(self, program: Program, reporter) -> None:
+        self._program = program
+        self._parent_maps: dict[str, dict] = {}
+        for fi in program.table.functions.values():
+            for node in iter_own_nodes(fi.node):
+                if isinstance(node, ast.Call) \
+                        and self._is_span_start(node):
+                    self._check_start(fi, node, reporter)
+
+    @staticmethod
+    def _is_span_start(node: ast.Call) -> bool:
+        chain = chain_of(node.func)
+        return bool(chain) and len(chain) >= 2 \
+            and chain[-2] == "tracing" \
+            and chain[-1] in ("start", "start_root")
+
+    def _check_start(self, fi: FunctionInfo, start: ast.Call,
+                     reporter) -> None:
+        parent = self._parent_of(fi, start)
+        if isinstance(parent, ast.withitem):
+            return                              # with tracing.start()
+        if isinstance(parent, (ast.Return, ast.Call, ast.Yield,
+                               ast.YieldFrom)):
+            return                              # ownership moves out
+        if not (isinstance(parent, ast.Assign)
+                and len(parent.targets) == 1
+                and isinstance(parent.targets[0], ast.Name)):
+            if isinstance(parent, ast.Expr):
+                reporter.report(
+                    self, fi.rel, start.lineno,
+                    f"span started and immediately dropped in "
+                    f"{fi.name}() — it can never finish and squats "
+                    f"in the in-flight table forever")
+            return
+        name = parent.targets[0].id
+        verdict = self._span_escapes(fi, name, start, set())
+        if verdict is True:
+            return
+        if verdict is False:
+            reporter.report(
+                self, fi.rel, start.lineno,
+                f"span {name!r} started in {fi.name}() never "
+                f"finishes on any path (no finish(), no `with`, no "
+                f"ownership transfer) — it leaks into the in-flight "
+                f"table")
+        else:                    # (callee_qual, reason)
+            callee = verdict[0]
+            reporter.report(
+                self, fi.rel, start.lineno,
+                f"span {name!r} started in {fi.name}() is handed to "
+                f"{_short(callee)}(), which never finishes it on any "
+                f"path — the span leaks transitively")
+
+    def _parent_of(self, fi: FunctionInfo, node: ast.AST):
+        # per-function parent map, built lazily and cached on the rule
+        # instance (FunctionInfo has __slots__ — it can't carry it)
+        cache = self._parent_maps.get(fi.qual)
+        if cache is None:
+            cache = {}
+            stack = [fi.node]
+            while stack:
+                cur = stack.pop()
+                for child in ast.iter_child_nodes(cur):
+                    cache[id(child)] = cur
+                    stack.append(child)
+            self._parent_maps[fi.qual] = cache
+        return cache.get(id(node))
+
+    def _span_escapes(self, fi: FunctionInfo, name: str,
+                      start: ast.AST, visited: set):
+        """True = finished/owned somewhere; False = provably dropped;
+        (callee_qual,) = transferred to a resolved callee that never
+        finishes it."""
+        program = self._program
+        sites = {s.node: s for s in program.calls.get(fi.qual, ())}
+        transferred_dead = None
+        for node in iter_own_nodes(fi.node):
+            if isinstance(node, ast.withitem) \
+                    and isinstance(node.context_expr, ast.Name) \
+                    and node.context_expr.id == name:
+                return True
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == name \
+                        and f.attr in ("finish", "end", "close"):
+                    return True
+                if node is not start:
+                    for idx, a in enumerate(node.args):
+                        if name not in _names_in(a):
+                            continue
+                        handled = self._callee_finishes(
+                            sites.get(node), idx, visited)
+                        if handled is True:
+                            return True
+                        if handled is None:
+                            return True      # unresolved: assume owned
+                        site = sites.get(node)
+                        transferred_dead = (
+                            site.target.qual if site and site.target
+                            else "<callee>",)
+                    for k in node.keywords:
+                        if name in _names_in(k.value):
+                            return True      # kwarg mapping: assume ok
+            if isinstance(node, ast.Assign) and node.value is not None \
+                    and not (isinstance(node.value, ast.Call)
+                             and node.value is start) \
+                    and name in _names_in(node.value):
+                return True                  # aliased / stored away
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                    and node.value is not None \
+                    and name in _names_in(node.value):
+                return True
+        return transferred_dead if transferred_dead else False
+
+    def _callee_finishes(self, site, arg_idx: int, visited: set):
+        """Does the resolved callee finish (or take ownership of) its
+        parameter at `arg_idx`? None = can't tell (unresolved callee
+        or unmappable parameter) — treated as owned, bounded
+        optimism."""
+        if site is None or site.kind != "resolved" \
+                or site.target is None:
+            return None
+        target = site.target
+        if target.qual in visited:
+            return True                      # cycle: give up quietly
+        visited.add(target.qual)
+        args = target.node.args
+        pos = args.posonlyargs + args.args
+        offset = 1 if target.cls is not None \
+            and not isinstance(site.node.func, ast.Name) else 0
+        idx = arg_idx + offset
+        if idx >= len(pos):
+            return None
+        pname = pos[idx].arg
+        program = self._program
+        sites = {s.node: s for s in program.calls.get(target.qual, ())}
+        for node in iter_own_nodes(target.node):
+            if isinstance(node, ast.withitem) \
+                    and isinstance(node.context_expr, ast.Name) \
+                    and node.context_expr.id == pname:
+                return True
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == pname \
+                        and f.attr in ("finish", "end", "close"):
+                    return True
+                for i2, a in enumerate(node.args):
+                    if pname in _names_in(a):
+                        sub = self._callee_finishes(
+                            sites.get(node), i2, visited)
+                        if sub is not False:
+                            return True
+                for k in node.keywords:
+                    if pname in _names_in(k.value):
+                        return True
+            if isinstance(node, ast.Assign) and node.value is not None \
+                    and pname in _names_in(node.value):
+                return True
+            if isinstance(node, (ast.Return, ast.Yield,
+                                 ast.YieldFrom)) \
+                    and node.value is not None \
+                    and pname in _names_in(node.value):
+                return True
+        return False
+
+
+class UnresolvedCallRule(ProgramRule):
+    id = "unresolved-call"
+    title = "call the bounded resolver could not pin (advisory)"
+    rationale = ("the whole-program passes are only as good as call "
+                 "resolution, and resolution is deliberately bounded "
+                 "(no type inference, no dataflow). This diagnostic "
+                 "makes the blind spots visible: every call that is "
+                 "neither resolved in-tree nor provably external. It "
+                 "never gates — tests/test_callgraph.py ceilings the "
+                 "rate so precision can't silently rot.")
+    example = "self._volume(vid).write(n)   # receiver is a call result"
+    fix = ("nothing to fix at the site; if the rate creeps up, teach "
+           "symbols.py the new idiom")
+    advisory = True
+
+    def __init__(self, emit_sites: bool = False):
+        self.emit_sites = emit_sites
+
+    def run(self, program: Program, reporter) -> None:
+        if not self.emit_sites:
+            return
+        for fi in program.table.functions.values():
+            for site in program.calls.get(fi.qual, ()):
+                if site.kind == "unresolved":
+                    reporter.report(
+                        self, fi.rel, site.lineno,
+                        f"unresolved call {site.what}() in "
+                        f"{fi.name}() — invisible to the "
+                        f"whole-program passes")

@@ -87,19 +87,16 @@ def parse(ctx) -> list[Suppression]:
     return sups
 
 
-def apply(ctx, *, check_unused: bool = True) -> None:
-    """Mark findings matched by a suppression; flag unused ones.
-
-    ``check_unused`` is off when only a rule subset runs (--select):
-    a suppression for an unselected rule would look unused even though
-    the full run needs it."""
-    sups = parse(ctx)
+def mark(findings, sups) -> None:
+    """Mark findings matched by a suppression (shared by the per-file
+    walk and the phase-2 merge — whole-program findings ride the same
+    per-line comments)."""
     if not sups:
         return
     by_line: dict[int, list[Suppression]] = {}
     for s in sups:
         by_line.setdefault(s.line, []).append(s)
-    for f in ctx.findings:
+    for f in findings:
         if f.rule in ("suppress-format", "unused-suppression"):
             continue                # the meta-rules are unsuppressable
         for s in by_line.get(f.line, ()):
@@ -108,6 +105,32 @@ def apply(ctx, *, check_unused: bool = True) -> None:
                 f.suppress_reason = s.reason
                 s.used = True
                 break
+
+
+def unused_findings(path, rel, sups) -> list:
+    """Findings for suppressions nothing matched. Only meaningful
+    after EVERY phase that could use them has run — the driver calls
+    this last."""
+    from .core import Finding
+    return [Finding(
+        path=path, rel=rel, line=s.line, rule="unused-suppression",
+        message=f"suppression for {sorted(s.rules)} matches no "
+                f"finding — delete it (the bug it excused is gone)")
+        for s in sups if not s.used]
+
+
+def apply(ctx, *, check_unused: bool = True) -> list:
+    """Parse + apply suppressions for one file's phase-1 findings;
+    returns the suppressions so later phases can match against them.
+
+    ``check_unused`` is off when only a rule subset runs (--select) —
+    a suppression for an unselected rule would look unused even though
+    the full run needs it — and off in the two-phase driver, which
+    judges unused-ness only after phase 2."""
+    sups = parse(ctx)
+    if not sups:
+        return []
+    mark(ctx.findings, sups)
     if check_unused:
         for s in sups:
             if not s.used:
@@ -116,3 +139,4 @@ def apply(ctx, *, check_unused: bool = True) -> None:
                            f"no finding — delete it (the bug it excused "
                            f"is gone)")
     ctx.findings.sort(key=lambda f: (f.line, f.rule))
+    return sups

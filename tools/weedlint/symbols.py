@@ -1,0 +1,440 @@
+"""Phase-2 symbol table: every module, class, method and function in
+the scanned tree, indexed for call resolution.
+
+Phase 1 is a per-file walk and can never see past a file boundary;
+the whole-program passes (callgraph.py) need to answer "what does
+`self.client.upload` resolve to" from another module entirely. This
+module builds the shared substrate once per run:
+
+- modules keyed by dotted name (``seaweedfs_tpu.util.client``),
+  derived from the path relative to the scan roots' parent;
+- per-module import maps (``import a.b as x`` / ``from a import b``,
+  including relative forms) so attribute chains resolve across files;
+- classes with their methods, base-class chains (bounded MRO walk) and
+  an *attribute-type* map harvested from ``self.x = ClassName(...)``
+  assignments — the heuristic that lets ``self.client.upload(...)``
+  resolve to ``WeedClient.upload``;
+- per-function local variable types from ``x = ClassName(...)``
+  assignments, same idea one scope down.
+
+Resolution is explicitly bounded: anything this table cannot prove is
+reported (not guessed) by callgraph.py as an ``unresolved-call`` so
+precision stays measurable — see STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+from .core import iter_py_files, relpath
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# modules that are never in-tree: calls into them are "external", not
+# "unresolved" (the unresolved-call rate must measure OUR resolution
+# power, not the size of the stdlib)
+EXTERNAL_MODULES = set(getattr(sys, "stdlib_module_names", ())) | {
+    "aiohttp", "jax", "jaxlib", "numpy", "np", "prometheus_client",
+    "pytest", "requests", "PIL", "yaml", "multidict", "yarl",
+    "sqlite3", "uvloop", "fuse",
+}
+
+
+class FunctionInfo:
+    """One def/async def: module-level function or class method."""
+
+    __slots__ = ("module", "cls", "name", "qual", "node", "is_async",
+                 "is_generator", "rel", "lineno", "var_types")
+
+    def __init__(self, module: "ModuleInfo", cls: "ClassInfo | None",
+                 node: ast.AST):
+        self.module = module
+        self.cls = cls
+        self.name = node.name
+        self.qual = (f"{module.name}.{cls.name}.{node.name}" if cls
+                     else f"{module.name}.{node.name}")
+        self.node = node
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        # calling a generator function executes NOTHING — its body
+        # runs at next()/iteration time (which this tree drives from
+        # the executor: h_volume_tail's locked per-record reads), so
+        # blocking propagation must not flow through the call edge
+        self.is_generator = _has_own_yield(node)
+        self.rel = module.rel
+        self.lineno = node.lineno
+        self.var_types: dict[str, str] = {}   # local name -> chain str
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<fn {self.qual}>"
+
+
+class ClassInfo:
+    __slots__ = ("module", "name", "qual", "node", "bases", "methods",
+                 "attr_types", "prop_aliases", "timeout_attrs")
+
+    def __init__(self, module: "ModuleInfo", node: ast.ClassDef):
+        self.module = module
+        self.name = node.name
+        self.qual = f"{module.name}.{node.name}"
+        self.node = node
+        self.bases = [_chain_str(b) for b in node.bases]
+        self.bases = [b for b in self.bases if b]
+        self.methods: dict[str, FunctionInfo] = {}
+        self.attr_types: dict[str, str] = {}  # self.x -> ctor chain str
+        # @property def http(self): return self._session  ->
+        # {'http': '_session'}: lets receiver checks follow the one
+        # hop of indirection the accessor idiom adds
+        self.prop_aliases: dict[str, str] = {}
+        # attrs ever assigned `<call>(..., timeout=<non-None>)` —
+        # evidence the object was constructed owning a deadline
+        # (sessions built by tls.make_session(timeout=...))
+        self.timeout_attrs: set[str] = set()
+
+
+class ModuleInfo:
+    __slots__ = ("name", "rel", "path", "tree", "src", "imports",
+                 "from_symbols", "functions", "classes", "lock_names")
+
+    def __init__(self, name: str, rel: str, path: str,
+                 tree: ast.AST, src: str):
+        self.name = name
+        self.rel = rel
+        self.path = path
+        self.tree = tree
+        self.src = src
+        self.imports: dict[str, str] = {}       # alias -> dotted module
+        self.from_symbols: dict[str, tuple[str, str]] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        # module-level names bound to Lock()/RLock()/Semaphore()
+        self.lock_names: set[str] = set()
+
+    @property
+    def package(self) -> str:
+        if self.rel.endswith("__init__.py"):
+            return self.name
+        return self.name.rpartition(".")[0]
+
+
+def _has_own_yield(fn_node: ast.AST) -> bool:
+    """Yield/YieldFrom in `fn_node`'s OWN body (nested defs are their
+    own schedulable units)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            return True
+        if isinstance(node, (*_FUNC_NODES, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _chain_str(node: ast.AST) -> str:
+    parts = chain_of(node)
+    return ".".join(parts) if parts else ""
+
+
+def chain_of(node: ast.AST) -> tuple[str, ...] | None:
+    """Flatten `a.b.c` / `self.x.f` into ('a','b','c'). A chain rooted
+    at a call (``get_loop().sendfile``) keeps a '<call>' head so the
+    tail is still inspectable; one rooted at a literal
+    (``"a,b".split``) keeps '<const>' — methods on literals are always
+    builtin; anything else is None."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif isinstance(cur, ast.Call):
+        parts.append("<call>")
+    elif isinstance(cur, (ast.Constant, ast.JoinedStr)):
+        parts.append("<const>")
+    else:
+        return None
+    return tuple(reversed(parts))
+
+
+def _module_name(path: str, root: str) -> str:
+    """Dotted module name relative to the scan root's PARENT, so the
+    root directory's own name is the top package (seaweedfs_tpu/...,
+    tools/..., or a fixture tree's top dir)."""
+    base = os.path.dirname(os.path.abspath(root))
+    rel = os.path.relpath(os.path.abspath(path), base)
+    rel = rel[:-3] if rel.endswith(".py") else rel
+    parts = rel.replace(os.sep, "/").split("/")
+    if parts and parts[-1] == "__init__":
+        parts.pop()
+    return ".".join(p for p in parts if p not in ("..", "."))
+
+
+def _property_alias(item: ast.AST) -> str | None:
+    """'http' -> '_session' for the accessor idiom: an @property whose
+    last statement is `return self.<attr>` (an assert guard before it
+    is tolerated — shell/env.py's shape)."""
+    if not isinstance(item, ast.FunctionDef):
+        return None
+    if not any(isinstance(d, ast.Name) and d.id == "property"
+               for d in item.decorator_list):
+        return None
+    stmts = [s for s in item.body
+             if not (isinstance(s, ast.Expr)
+                     and isinstance(s.value, ast.Constant))]
+    while stmts and isinstance(stmts[0], ast.Assert):
+        stmts.pop(0)
+    if len(stmts) == 1 and isinstance(stmts[0], ast.Return) \
+            and isinstance(stmts[0].value, ast.Attribute) \
+            and isinstance(stmts[0].value.value, ast.Name) \
+            and stmts[0].value.value.id == "self":
+        return stmts[0].value.attr
+    return None
+
+
+_LOCK_CTORS = {"Lock", "RLock", "Semaphore", "BoundedSemaphore",
+               "Condition"}
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    tail = chain_of(value.func)
+    return bool(tail) and tail[-1] in _LOCK_CTORS
+
+
+class SymbolTable:
+    """The whole-program index. Build once, share across passes."""
+
+    def __init__(self, roots: list[str]):
+        self.roots = [os.path.abspath(r) for r in roots]
+        self.modules: dict[str, ModuleInfo] = {}
+        self.by_rel: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.method_index: dict[str, list[FunctionInfo]] = {}
+        self.class_index: dict[str, list[ClassInfo]] = {}
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def build(cls, roots: list[str]) -> "SymbolTable":
+        table = cls(roots)
+        for root in table.roots:
+            for path in iter_py_files([root]):
+                table._add_file(path, root)
+        for mod in table.modules.values():
+            for ci in mod.classes.values():
+                table._harvest_attr_types(ci)
+        return table
+
+    def _add_file(self, path: str, root: str) -> None:
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError):
+            return                      # phase 1 reports syntax errors
+        name = _module_name(path, root)
+        mod = ModuleInfo(name, relpath(path), path, tree, src)
+        self.modules[name] = mod
+        self.by_rel[mod.rel] = mod
+        for node in tree.body:
+            self._index_top(mod, node)
+        # function-level imports (the tree's cycle-avoidance idiom:
+        # `from ..util.connpool import SyncHttpPool` inside __init__)
+        # join the module maps — top-level bindings win on collision
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    key = alias.asname or alias.name.split(".")[0]
+                    mod.imports.setdefault(
+                        key, alias.name if alias.asname
+                        else alias.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from(mod, node)
+                if base is not None:
+                    for alias in node.names:
+                        mod.from_symbols.setdefault(
+                            alias.asname or alias.name,
+                            (base, alias.name))
+
+    def _index_top(self, mod: ModuleInfo, node: ast.AST) -> None:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mod.imports[alias.asname or
+                            alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else
+                    alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            base = self._resolve_from(mod, node)
+            if base is not None:
+                for alias in node.names:
+                    mod.from_symbols[alias.asname or alias.name] = (
+                        base, alias.name)
+        elif isinstance(node, _FUNC_NODES):
+            fi = FunctionInfo(mod, None, node)
+            mod.functions[node.name] = fi
+            self._register(fi)
+        elif isinstance(node, ast.ClassDef):
+            ci = ClassInfo(mod, node)
+            mod.classes[node.name] = ci
+            self.class_index.setdefault(ci.name, []).append(ci)
+            for item in node.body:
+                if isinstance(item, _FUNC_NODES):
+                    fi = FunctionInfo(mod, ci, item)
+                    ci.methods[item.name] = fi
+                    self._register(fi)
+                    alias = _property_alias(item)
+                    if alias:
+                        ci.prop_aliases[item.name] = alias
+        elif isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    mod.lock_names.add(t.id)
+        elif isinstance(node, (ast.If, ast.Try)):
+            # metrics.py's `if HAVE_PROMETHEUS:` / try-import guards:
+            # one level of conditional nesting is still "top level"
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, (ast.Import, ast.ImportFrom,
+                                    *_FUNC_NODES, ast.ClassDef,
+                                    ast.Assign)):
+                    self._index_top(mod, sub)
+
+    def _register(self, fi: FunctionInfo) -> None:
+        self.functions[fi.qual] = fi
+        self.method_index.setdefault(fi.name, []).append(fi)
+
+    def _resolve_from(self, mod: ModuleInfo,
+                      node: ast.ImportFrom) -> str | None:
+        if node.level == 0:
+            return node.module or ""
+        pkg = mod.package
+        for _ in range(node.level - 1):
+            pkg = pkg.rpartition(".")[0]
+        if node.module:
+            return f"{pkg}.{node.module}" if pkg else node.module
+        return pkg or None
+
+    def _harvest_attr_types(self, ci: ClassInfo) -> None:
+        """self.x = Ctor(...) anywhere in the class -> attr x has the
+        ctor's (chain-string) type. Last assignment wins; a non-ctor
+        reassignment poisons the entry (bounded honesty)."""
+        for fi in ci.methods.values():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                value = node.value
+                for t in targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    if isinstance(value, ast.Call):
+                        if any(k.arg and "timeout" in k.arg
+                               and not (isinstance(k.value, ast.Constant)
+                                        and k.value.value is None)
+                               for k in value.keywords):
+                            ci.timeout_attrs.add(t.attr)
+                        resolved = self.resolve_class_chain(
+                            fi, chain_of(value.func))
+                        if resolved is not None:
+                            ci.attr_types[t.attr] = resolved.qual
+                            continue
+                    ci.attr_types.pop(t.attr, None)
+
+    # -- lookups --------------------------------------------------------
+    def resolve_module(self, dotted: str) -> ModuleInfo | None:
+        return self.modules.get(dotted)
+
+    def class_by_qual(self, qual: str) -> ClassInfo | None:
+        mod_name, _, cls_name = qual.rpartition(".")
+        mod = self.modules.get(mod_name)
+        return mod.classes.get(cls_name) if mod else None
+
+    def iter_mro(self, ci: ClassInfo, _seen=None):
+        """The class then its resolvable bases, depth-first, bounded
+        by a visited set (diamonds/cycles terminate)."""
+        seen = _seen if _seen is not None else set()
+        if ci.qual in seen:
+            return
+        seen.add(ci.qual)
+        yield ci
+        for base in ci.bases:
+            target = self._resolve_base(ci, base)
+            if target is not None:
+                yield from self.iter_mro(target, seen)
+
+    def _resolve_base(self, ci: ClassInfo,
+                      base: str) -> ClassInfo | None:
+        mod = ci.module
+        head, _, tail = base.partition(".")
+        if not tail:                      # bare name: local or from-import
+            if head in mod.classes:
+                return mod.classes[head]
+            fs = mod.from_symbols.get(head)
+            if fs:
+                target = self.modules.get(fs[0])
+                if target:
+                    return target.classes.get(fs[1])
+            return None
+        # dotted: alias.Class or package.module.Class
+        alias = mod.imports.get(head)
+        if alias:
+            target = self.modules.get(f"{alias}.{tail}".rpartition(".")[0]
+                                      if "." in tail else alias)
+            if target:
+                return target.classes.get(tail.rpartition(".")[2])
+        fs = mod.from_symbols.get(head)
+        if fs:                            # from a import b; class C(b.X)
+            target = self.modules.get(f"{fs[0]}.{fs[1]}")
+            if target:
+                return target.classes.get(tail)
+        return None
+
+    def lookup_method(self, ci: ClassInfo,
+                      name: str) -> FunctionInfo | None:
+        for c in self.iter_mro(ci):
+            if name in c.methods:
+                return c.methods[name]
+        return None
+
+    def resolve_class_chain(self, fi: FunctionInfo,
+                            chain: tuple[str, ...] | None
+                            ) -> ClassInfo | None:
+        """Resolve a constructor reference (`WeedClient`,
+        `client.WeedClient`, `chunk_cache.TieredChunkCache`) to its
+        ClassInfo from `fi`'s scope."""
+        if not chain:
+            return None
+        mod = fi.module
+        head = chain[0]
+        if len(chain) == 1:
+            if head in mod.classes:
+                return mod.classes[head]
+            fs = mod.from_symbols.get(head)
+            if fs:
+                target = self.modules.get(fs[0])
+                if target and fs[1] in target.classes:
+                    return target.classes[fs[1]]
+            return None
+        target_mod = self._module_of_head(mod, head)
+        if target_mod is not None and len(chain) == 2:
+            return target_mod.classes.get(chain[1])
+        return None
+
+    def _module_of_head(self, mod: ModuleInfo,
+                        head: str) -> ModuleInfo | None:
+        """What module does the name `head` refer to inside `mod`?"""
+        fs = mod.from_symbols.get(head)
+        if fs:
+            sub = self.modules.get(f"{fs[0]}.{fs[1]}"
+                                   if fs[0] else fs[1])
+            if sub is not None:
+                return sub
+        alias = mod.imports.get(head)
+        if alias:
+            return self.modules.get(alias)
+        return None
