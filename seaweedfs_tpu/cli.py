@@ -363,6 +363,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "batches ANY -readMode's order (-readMode "
                          "batch implies 32); reports req/s and "
                          "needles/s")
+    bm.add_argument("-pipeline", type=int, default=0,
+                    help="in-flight reads multiplexed per persistent "
+                         "binary frame connection (util/frame.py); >0 "
+                         "pipelines ANY -readMode's order depth-N over "
+                         "one frame socket per server per client "
+                         "(channel failures fall back to HTTP; a "
+                         "missing needle is fatal, like single GETs); "
+                         "mutually exclusive with -batchSize")
 
     bk = sub.add_parser("backup", help="incrementally back up one volume "
                                        "from a volume server to a local dir")
@@ -1220,8 +1228,13 @@ async def _run_benchmark(args) -> None:
     # -batchSize / -readMode batch: reads ride multi-needle /batch GETs
     batch_size = args.batchSize or (32 if args.readMode == "batch"
                                     else 0)
+    pipeline = args.pipeline
+    if pipeline and batch_size:
+        raise SystemExit("-pipeline and -batchSize are mutually "
+                         "exclusive read transports")
     read_reqs = 0                        # wire requests (batch != needle)
     needles_read = 0
+    frame_fallbacks = 0                  # pipeline reads downgraded to HTTP
 
     async def lookup(mconn: _RawConn, vid: str) -> str:
         url = vol_locs.get(vid)
@@ -1236,14 +1249,24 @@ async def _run_benchmark(args) -> None:
 
     async def worker(phase: str, order: list[str]) -> None:
         nonlocal deletes, read_bytes, wi, ri, read_reqs, needles_read
+        nonlocal frame_fallbacks
         mconn = await _RawConn.open(master)
         vconns: dict[str, _RawConn] = {}
+        fchannels: dict[str, object] = {}
 
         async def vconn(hostport: str) -> _RawConn:
             c = vconns.get(hostport)
             if c is None:
                 c = vconns[hostport] = await _RawConn.open(hostport)
             return c
+
+        def fchannel(hostport: str):
+            ch = fchannels.get(hostport)
+            if ch is None:
+                from .util.frame import FrameChannel
+                ch = fchannels[hostport] = FrameChannel(
+                    target=hostport, ssl=tls.client_ctx())
+            return ch
 
         try:
             while True:
@@ -1279,6 +1302,56 @@ async def _run_benchmark(args) -> None:
                         deletes += 1
                     else:
                         fids.append(fid)
+                elif pipeline:
+                    if ri >= len(order):
+                        return
+                    group = order[ri:ri + pipeline]
+                    ri += len(group)
+                    by_server: dict[str, list[str]] = {}
+                    for fid in group:
+                        by_server.setdefault(
+                            await lookup(mconn, fid.split(",")[0]),
+                            []).append(fid)
+                    from .util.frame import FrameChannelError
+                    for server, fids_here in by_server.items():
+                        ch = fchannel(server)
+                        failed: list[str] = []
+
+                        async def one(fid: str) -> None:
+                            nonlocal read_bytes, needles_read
+                            nonlocal read_reqs
+                            t0 = time.perf_counter()
+                            try:
+                                st, _, data = await ch.request(
+                                    "GET", "/" + fid)
+                            except (FrameChannelError, OSError):
+                                failed.append(fid)
+                                return
+                            read_lat.append(time.perf_counter() - t0)
+                            if st != 200:
+                                raise RuntimeError(
+                                    f"pipelined read {fid}: {st}")
+                            read_reqs += 1
+                            needles_read += 1
+                            read_bytes += len(data)
+
+                        # depth-`pipeline` window: every request is in
+                        # flight on ONE multiplexed frame connection
+                        await asyncio.gather(*(one(f)
+                                               for f in fids_here))
+                        # channel-level failures ride HTTP, serially on
+                        # this worker's keep-alive conn (rare path)
+                        for fid in failed:
+                            frame_fallbacks += 1
+                            vc = await vconn(server)
+                            t0 = time.perf_counter()
+                            st, data = await vc.request("GET", "/" + fid)
+                            if st != 200:
+                                raise RuntimeError(f"read {fid}: {st}")
+                            read_lat.append(time.perf_counter() - t0)
+                            read_reqs += 1
+                            needles_read += 1
+                            read_bytes += len(data)
                 elif batch_size:
                     if ri >= len(order):
                         return
@@ -1328,6 +1401,8 @@ async def _run_benchmark(args) -> None:
             mconn.close()
             for c in vconns.values():
                 c.close()
+            for ch in fchannels.values():
+                await ch.close()
 
     wdt = 0.0
     if do_write:
@@ -1389,6 +1464,12 @@ async def _run_benchmark(args) -> None:
             print(f"  needles/s: {needles_read / rdt:.1f} "
                   f"(batch={batch_size}, {needles_read} needles over "
                   f"{read_reqs} requests)")
+        if pipeline:
+            # the overlap headline: depth-N multiplexed frames on one
+            # socket per server, no round-trip wait per needle
+            print(f"  needles/s: {needles_read / rdt:.1f} "
+                  f"(pipeline={pipeline} over frames, "
+                  f"{frame_fallbacks} HTTP fallbacks)")
         print(f"  latency ms p50/p95/p99/max: {pct(read_lat, 50):.1f}/"
               f"{pct(read_lat, 95):.1f}/{pct(read_lat, 99):.1f}/"
               f"{max(read_lat) * 1e3:.1f}")

@@ -32,6 +32,7 @@ import re
 
 from ..util import tracing
 from ..util.failpoints import pending as _fp_pending
+from ..util.frame import MAGIC as _FRAME_MAGIC
 from . import wire
 
 _REQ_LINE = re.compile(
@@ -122,6 +123,20 @@ class FastNeedleProtocol(asyncio.Protocol):
         """Handle complete fast requests at the head of the buffer;
         upgrade the connection on the first cold one."""
         while not self._closed:
+            if self.buf[:1] == _FRAME_MAGIC[:1]:
+                # binary frame preamble (util/frame.py): no HTTP method
+                # starts with this byte, so the connection is either a
+                # frame client or garbage — swap protocols in place
+                # once the magic is complete (frameserver drops
+                # mismatches with GOAWAY via its decoder)
+                if self.buf.startswith(_FRAME_MAGIC):
+                    self._upgrade_frames()
+                    return
+                if len(self.buf) < len(_FRAME_MAGIC) and \
+                        _FRAME_MAGIC.startswith(bytes(self.buf)):
+                    return            # preamble still arriving
+                self._upgrade()       # same first byte, not the magic:
+                return                # let the full parser answer
             head_end = self.buf.find(b"\r\n\r\n")
             if head_end < 0:
                 if len(self.buf) > 32 * 1024:
@@ -425,6 +440,21 @@ class FastNeedleProtocol(asyncio.Protocol):
         a proxy hop."""
         proto = self.vs._runner.server()
         raw = bytes(self.buf)
+        self.buf.clear()
+        self._closed = True          # this protocol is done
+        getattr(self.vs, "_fast_conns", set()).discard(self.transport)
+        self.transport.set_protocol(proto)
+        proto.connection_made(self.transport)
+        if raw:
+            proto.data_received(raw)
+
+    def _upgrade_frames(self) -> None:
+        """Swap this connection onto the frame-protocol terminator
+        (server/frameserver.py) — the binary sibling wire — keeping
+        the real transport and peer address like the aiohttp upgrade."""
+        from .frameserver import FrameServerProtocol
+        proto = FrameServerProtocol(self.vs)
+        raw = bytes(self.buf[len(_FRAME_MAGIC):])
         self.buf.clear()
         self._closed = True          # this protocol is done
         getattr(self.vs, "_fast_conns", set()).discard(self.transport)

@@ -115,8 +115,23 @@ class VolumeServer:
         self._ec_locations = EcLocationCache(self._lookup_ec_locations)
         # shared keep-alive pool for SYNC (executor-thread) shard/meta
         # fetches — one handshake per holder, not one per interval
-        from ..util.connpool import SyncHttpPool
+        from ..util.connpool import SyncFramePool, SyncHttpPool
         self._sync_pool = SyncHttpPool(timeout=30.0)
+        # binary sibling wire (util/frame.py): persistent multiplexed
+        # frame channels to sibling workers (and, for the EC gather,
+        # to remote shard holders) with automatic HTTP fallback
+        from ..util.frame import FrameHub
+        self.frame_hub = FrameHub(
+            token=worker_ctx.token if worker_ctx is not None else "",
+            ssl=tls.client_ctx())
+        self._sync_frames = SyncFramePool(
+            timeout=30.0,
+            token=worker_ctx.token if worker_ctx is not None else "")
+        # targets that refused the frame handshake: monotonic deadline
+        # until which their shard fetches ride the HTTP pool
+        self._no_frame: dict[str, float] = {}
+        self._frame_uds = ""
+        self._frame_server = None
         # paced background parity scrubber (-scrub.interval > 0 starts
         # the loop; the object always exists so POST /debug/scrub?run=1
         # can force a cycle even when the loop is off)
@@ -225,11 +240,44 @@ class VolumeServer:
                           f"volume {vid}) circuit open"}, status=503)
         # the cross-worker hop is its own span, and proxy_request stamps
         # its traceparent on the forwarded request so the sibling's
-        # server span nests under it — one trace across both workers
+        # server span nests under it — one trace across both workers.
+        # The binary frame hop is tried first (transport=frame on the
+        # span); any channel failure falls back to the HTTP hop, which
+        # is also where streaming/oversized bodies always go.
         with tracing.start("proxy", "sibling", target=target,
                            worker=wc.owner_index(vid)) as sp:
+            # the sibling-hop chaos site fires for BOTH transports —
+            # an armed worker.proxy fault must keep tripping this
+            # breaker exactly as it did when the hop was HTTP-only
+            # (tools/soak.py slo depends on it), so it runs before
+            # the frame attempt and takes the same 502 path
+            try:
+                await failpoints.fail("worker.proxy")
+            except OSError as e:
+                br.record_failure()
+                sp.status = "502"
+                return web.json_response(
+                    {"error": f"worker proxy to {target}: {e}"},
+                    status=502)
+            from ..util.frame import FrameChannelError
+            ch = self.sibling_frame_channel(wc.owner_index(vid))
+            if ch is not None and wk.frame_eligible(req):
+                try:
+                    resp = await wk.proxy_request_frame(req, ch)
+                except FrameChannelError as e:
+                    # dead channel / peer predates frames / injected
+                    # worker.frame fault: the HTTP hop is authoritative
+                    sp.event("frame_fallback", error=str(e)[:120])
+                else:
+                    sp.set("transport", "frame")
+                    br.record_success()
+                    sp.status = "ok" if resp.status < 400 \
+                        else str(resp.status)
+                    return resp
+            sp.set("transport", "http")
             resp = await wk.proxy_request(req, self._http, target,
-                                          wc.token)
+                                          wc.token,
+                                          fire_failpoint=False)
             if resp.status == 502:
                 br.record_failure()
                 sp.status = "502"
@@ -359,7 +407,26 @@ class VolumeServer:
                 self.store.public_url.endswith(":0"):
             self.store.public_url = self.url
         if wc is not None:
-            wc.write_state(ip=self.ip, port=self.port, role="volume")
+            # per-worker unix-socket frame listener: the preferred
+            # intra-host transport for the binary sibling wire (TCP to
+            # the private port, magic-sniffed, is the fallback). Bound
+            # only when the path fits sockaddr_un.
+            from .frameserver import FrameServerProtocol
+            sock_path = os.path.join(wc.state_dir,
+                                     f"w{wc.index}.sock")
+            if len(sock_path) < 100 and hasattr(loop,
+                                                "create_unix_server"):
+                try:
+                    await self._in_executor(self._unlink_quiet,
+                                            sock_path)
+                    self._frame_server = await loop.create_unix_server(
+                        lambda: FrameServerProtocol(self), sock_path)
+                    self._frame_uds = sock_path
+                except OSError as e:
+                    glog.warning("frame unix listener %s: %s (TCP "
+                                 "fallback only)", sock_path, e)
+            wc.write_state(ip=self.ip, port=self.port, role="volume",
+                           frame_sock=self._frame_uds)
         # remote EC shard reads run inside executor threads, so they use a
         # synchronous client (readRemoteEcShardInterval, store_ec.go:211+);
         # the batched form gathers one request per holder
@@ -391,10 +458,37 @@ class VolumeServer:
                 tr.close()
         if getattr(self, "_priv_server", None) is not None:
             self._priv_server.close()
+        if self._frame_server is not None:
+            self._frame_server.close()
+        await self.frame_hub.close()
         if self._runner:
             await self._runner.cleanup()
+        if self._frame_uds:
+            await self._in_executor(self._unlink_quiet, self._frame_uds)
         self._sync_pool.close()
+        self._sync_frames.close()
         self.store.close()
+
+    @staticmethod
+    def _unlink_quiet(path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def sibling_frame_channel(self, idx: int):
+        """Persistent frame channel to sibling worker `idx` (unix
+        socket preferred, private TCP fallback), or None while the
+        sibling is down / frames are unavailable. Channels are cached
+        per destination, so a respawned sibling (new socket path or
+        port) transparently gets a fresh channel."""
+        wc = self.worker_ctx
+        if wc is None:
+            return None
+        uds, tcp = wc.sibling_frame(idx)
+        if not uds and not tcp:
+            return None
+        return self.frame_hub.get(target=tcp, uds_path=uds)
 
     _counters: dict = None  # type: ignore[assignment]
 
@@ -438,6 +532,36 @@ class VolumeServer:
                     break
         return out
 
+    def _sync_shard_fetch(self, target: str, query: dict,
+                          headers: dict) -> tuple[int, bytes]:
+        """One /admin/ec/shard_read fetch (executor threads only):
+        frame path first — tens of bytes of protocol overhead per
+        gather instead of HTTP headers — with a sticky per-target HTTP
+        downgrade when the holder refused the frame handshake
+        (predates the protocol), and a one-shot HTTP retry when the
+        frame transport failed mid-flight."""
+        from ..util.connpool import FrameUnsupported, PoolError
+        path = "/admin/ec/shard_read"
+        http_path = path + "?" + urllib.parse.urlencode(query)
+        now = time.monotonic()
+        if self._no_frame.get(target, 0.0) < now:
+            try:
+                return self._sync_frames.request(
+                    target, path, headers=headers, query=query)
+            except FrameUnsupported as e:
+                glog.V(1).infof("shard fetch %s: %s; HTTP for 60s",
+                                target, e)
+                if len(self._no_frame) > 256:
+                    self._no_frame.clear()
+                self._no_frame[target] = now + 60.0
+            except PoolError as e:
+                # transport failure, not a protocol refusal: this
+                # request rides HTTP, the next one retries frames
+                glog.V(1).infof("shard fetch %s over frames: %s; "
+                                "retrying over HTTP", target, e)
+        return self._sync_pool.request(target, http_path,
+                                       headers=headers)
+
     def _sync_fetch_remote_shard(self, vid: int, shard_id: int,
                                  offset: int, size: int) -> bytes | None:
         """Blocking remote shard interval fetch; locations come from the
@@ -461,18 +585,22 @@ class VolumeServer:
             attempted = True
             try:
                 failpoints.sync_fail("volume.ec_fetch")
-                status, data = self._sync_pool.request(
-                    target, f"/admin/ec/shard_read?volume={vid}"
-                            f"&shard={shard_id}&offset={offset}"
-                            f"&size={size}", headers=trace_headers)
-                if status == 200 and len(data) == size:
-                    return data
-                glog.warning("remote ec shard %d.%d from %s: http %d, "
-                             "%d/%d bytes", vid, shard_id, target,
-                             status, len(data), size)
-            except OSError as e:
-                # PoolError/timeouts: a swallowed holder failure must
-                # be visible
+                status, body = self._sync_shard_fetch(
+                    target,
+                    {"volume": str(vid),
+                     "reads": f"{shard_id}:{offset}:{size}"},
+                    trace_headers)
+                if status == 200:
+                    rows = batchframe.parse_all(body)
+                    if rows and rows[0][0].get("status") == 200 \
+                            and len(rows[0][1]) == size:
+                        return rows[0][1]
+                glog.warning("remote ec shard %d.%d from %s: "
+                             "status %d, %d bytes", vid, shard_id,
+                             target, status, len(body))
+            except (OSError, ValueError) as e:
+                # PoolError/timeouts/torn framing: a swallowed holder
+                # failure must be visible
                 glog.warning("remote ec shard %d.%d from %s: %s",
                              vid, shard_id, target, e)
                 continue
@@ -513,11 +641,11 @@ class VolumeServer:
                             for sid, off, size in group)
             try:
                 failpoints.sync_fail("volume.ec_fetch")
-                status, body = self._sync_pool.request(
-                    target, f"/admin/ec/shard_read?volume={vid}"
-                            f"&reads={spec}", headers=trace_headers)
+                status, body = self._sync_shard_fetch(
+                    target, {"volume": str(vid), "reads": spec},
+                    trace_headers)
                 if status != 200:
-                    raise OSError(f"http {status}")
+                    raise OSError(f"status {status}")
                 rows = batchframe.parse_all(body)
             except (OSError, ValueError) as e:
                 glog.warning("batched ec gather %d from %s (%d "
@@ -657,9 +785,11 @@ class VolumeServer:
                 req.transport.close()
             return sr
         if resp.sendfile is not None:
-            # the aiohttp listener keeps the buffered path; refs are
-            # only minted for the raw listener (wire want_ref)
-            resp.sendfile.close()
+            # zero-copy on the aiohttp listener too: a StreamResponse
+            # drains the NeedleRef region via loop.sendfile (the same
+            # shape aiohttp's own FileResponse uses), with a buffered
+            # executor-pread fallback where the transport refuses
+            return await self._respond_sendfile_web(req, resp)
         ct, _, rest = resp.content_type.partition(";")
         charset = rest.partition("charset=")[2].strip() or None
         if resp.head or resp.status in (304, 301):
@@ -671,6 +801,61 @@ class VolumeServer:
         return web.Response(body=resp.body, status=resp.status,
                             headers=resp.headers,
                             content_type=ct, charset=charset)
+
+    async def _respond_sendfile_web(self, req: web.Request,
+                                    resp: wire.WireResponse
+                                    ) -> web.StreamResponse:
+        """Drain a NeedleRef through aiohttp: kernel sendfile on plain
+        TCP transports (after the headers flush, exactly like
+        web.FileResponse), executor-chunked preads through the normal
+        writer where the transport refuses (TLS, tests' mocked
+        transports)."""
+        ref = resp.sendfile
+        try:
+            ct, _, rest = resp.content_type.partition(";")
+            sr = web.StreamResponse(status=resp.status,
+                                    headers=resp.headers)
+            sr.content_type = ct
+            charset = rest.partition("charset=")[2].strip()
+            if charset:
+                sr.charset = charset
+            sr.content_length = ref.length
+            await sr.prepare(req)
+            transport = req.transport
+            kernel_ok = (transport is not None
+                         and transport.get_extra_info("sslcontext")
+                         is None
+                         and transport.get_extra_info("socket")
+                         is not None)
+            if kernel_ok:
+                try:
+                    await asyncio.get_running_loop().sendfile(
+                        transport, ref.file, ref.offset, ref.length,
+                        fallback=False)
+                    await sr.write_eof()
+                    return sr
+                except (NotImplementedError, RuntimeError,
+                        AttributeError):
+                    pass              # transport refused: buffered path
+                except OSError:
+                    # mid-send tear: the declared Content-Length can no
+                    # longer be honored — sever like a buffered tear
+                    transport.close()
+                    return sr
+            off, remaining = ref.offset, ref.length
+            fd = ref.file.fileno()
+            while remaining:
+                chunk = await self._in_executor(
+                    os.pread, fd, min(1 << 20, remaining), off)
+                if not chunk:
+                    break             # truncated under us: short body
+                await sr.write(chunk)
+                off += len(chunk)
+                remaining -= len(chunk)
+            await sr.write_eof()
+            return sr
+        finally:
+            ref.close()
 
     async def h_get(self, req: web.Request) -> web.StreamResponse:
         wr = self._wire_request(req)
@@ -1380,6 +1565,12 @@ class VolumeServer:
         if gc["batches"]:
             out["group_commit"] = gc
         wc = self.worker_ctx
+        frames = self.frame_hub.stats_dict()
+        if frames:
+            # this worker's outbound frame channels (sibling hops +
+            # EC gathers), nested per worker index: the deterministic
+            # accounting the sibling bench scrapes
+            out["frames"] = {f"w{wc.index if wc else 0}": frames}
         if wc is not None and not self._is_worker_hop(req):
             # whole-host view: fold in every sibling's partition
             out["workers"] = wc.total
@@ -1391,6 +1582,8 @@ class VolumeServer:
                     continue
                 vols.extend(sib.get("volumes", []))
                 ec.update(sib.get("ecVolumes", {}))
+                if sib.get("frames"):
+                    out.setdefault("frames", {}).update(sib["frames"])
             vols.sort(key=lambda m: m.get("id", 0))
         return web.json_response(out)
 
@@ -1961,26 +2154,15 @@ class VolumeServer:
         vid = int(q["volume"])
         if "reads" in q:
             try:
-                reads = [tuple(int(x) for x in part.split(":"))
-                         for part in q["reads"].split(",") if part]
-                if any(len(r) != 3 for r in reads):
-                    raise ValueError
+                reads = batchframe.parse_reads_spec(q["reads"])
             except ValueError:
                 return web.json_response(
                     {"error": "bad reads spec"}, status=400)
             datas = await self._in_executor(
                 self.store.read_ec_shard_intervals, vid, reads)
-            out = bytearray()
-            for (sid, off, size), data in zip(reads, datas):
-                if data is None:
-                    out += batchframe.encode_record(
-                        {"shard": sid, "status": 404,
-                         "error": "shard not found"})
-                else:
-                    out += batchframe.encode_record(
-                        {"shard": sid, "status": 200}, data)
-            return web.Response(body=bytes(out),
-                                content_type=batchframe.CONTENT_TYPE)
+            return web.Response(
+                body=batchframe.encode_shard_rows(reads, datas),
+                content_type=batchframe.CONTENT_TYPE)
         data = await self._in_executor(lambda: self.store.read_ec_shard_interval(
                 vid, int(q["shard"]), int(q["offset"]), int(q["size"])))
         if data is None:

@@ -240,9 +240,11 @@ async def serve_read(vs, wr: WireRequest) -> WireResponse:
     try:
         if n is None:
             # zero-copy eligibility is decided from REQUEST shape here
-            # (body-shape checks below fall back): raw listener only,
-            # and nothing that forces the bytes through Python
-            want_ref = (wr.raw and wr.method == "GET"
+            # (body-shape checks below fall back): any listener — the
+            # raw path and the frame adapter sendfile into the socket,
+            # the aiohttp app drains the ref through a StreamResponse —
+            # but nothing that forces the bytes through Python
+            want_ref = (wr.method == "GET"
                         and wr.headers.get("etag-md5") != "True"
                         and "width" not in wr.query
                         and "height" not in wr.query
@@ -759,7 +761,27 @@ async def serve_batch(vs, wr: WireRequest) -> WireResponse:
         addr = wc.sibling_addr(idx)
         sub = [fids[i] for i in row_idxs]
         parsed: list[tuple[dict, bytes]] | None = None
-        if addr is not None:
+        # frame hop first: one multiplexed frame per sibling sub-batch
+        # instead of a full HTTP request (the channel carries the
+        # launch token; worker.frame faults and dead channels fall
+        # back to the HTTP hop below)
+        ch = vs.sibling_frame_channel(idx) \
+            if hasattr(vs, "sibling_frame_channel") else None
+        if ch is not None:
+            headers: dict = {}
+            tracing.inject(headers)
+            try:
+                status, _, payload = await ch.request(
+                    "GET", "/batch", query={"fids": ",".join(sub)},
+                    headers=headers)
+                if status == 200:
+                    parsed = batchframe.parse_all(payload)
+            except (OSError, ValueError):
+                parsed = None
+            if parsed is not None:
+                sp.event("sibling_batch", worker=idx,
+                         transport="frame")
+        if parsed is None and addr is not None:
             wk = _wk()
             headers = {wk.WORKER_HEADER: wc.token}
             tracing.inject(headers)
@@ -775,6 +797,9 @@ async def serve_batch(vs, wr: WireRequest) -> WireResponse:
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
                     ValueError):
                 parsed = None
+            if parsed is not None:
+                sp.event("sibling_batch", worker=idx,
+                         transport="http")
         if parsed is None or len(parsed) != len(row_idxs):
             for i in row_idxs:
                 rows[i] = ({"fid": fids[i], "status": 503,

@@ -34,6 +34,7 @@ import asyncio
 import hmac
 import json
 import os
+import re
 import signal
 import time
 
@@ -127,6 +128,18 @@ class WorkerContext:
             return None
         return f"{st['ip']}:{st['port']}"
 
+    def sibling_frame(self, index: int) -> tuple[str, str]:
+        """(unix socket path, tcp ip:port) for worker `index`'s frame
+        listener — the intra-host binary wire. Either may be empty:
+        the unix socket when the worker could not bind one (path too
+        long for sockaddr_un), both while the worker is down."""
+        st = self.read_state(index)
+        if not st:
+            return "", ""
+        tcp = f"{st['ip']}:{st['port']}" \
+            if "ip" in st and "port" in st else ""
+        return str(st.get("frame_sock", "") or ""), tcp
+
     def owner_addr(self, vid: int) -> str | None:
         return self.sibling_addr(self.owner_index(vid))
 
@@ -134,7 +147,8 @@ class WorkerContext:
         return [self.read_state(i) for i in range(self.total)]
 
 
-async def proxy_request(req, session, target: str, token: str):
+async def proxy_request(req, session, target: str, token: str,
+                        fire_failpoint: bool = True):
     """Stream one aiohttp request to a sibling worker and its response
     back — the in-worker proxy for needles/volumes owned by another
     partition. Small bodies are buffered so the sibling's raw fast path
@@ -143,14 +157,18 @@ async def proxy_request(req, session, target: str, token: str):
     import aiohttp
     from aiohttp import web
     from ..util import failpoints
-    try:
-        # chaos site: injected sibling-hop faults (FailpointError and
-        # FailpointDrop are OSErrors) take the same 502 path a crashed
-        # worker does, which is what trips the caller's breaker
-        await failpoints.fail("worker.proxy")
-    except OSError as e:
-        return web.json_response(
-            {"error": f"worker proxy to {target}: {e}"}, status=502)
+    if fire_failpoint:
+        try:
+            # chaos site: injected sibling-hop faults (FailpointError
+            # and FailpointDrop are OSErrors) take the same 502 path a
+            # crashed worker does, which is what trips the caller's
+            # breaker. The volume worker middleware fires this site
+            # ITSELF (before its frame-first attempt) and passes
+            # fire_failpoint=False so one hop never burns two counts.
+            await failpoints.fail("worker.proxy")
+        except OSError as e:
+            return web.json_response(
+                {"error": f"worker proxy to {target}: {e}"}, status=502)
     headers = {k: v for k, v in req.headers.items()
                if k.lower() not in _HOP_HEADERS
                and k.lower() != "accept-encoding"}
@@ -205,6 +223,76 @@ async def proxy_request(req, session, target: str, token: str):
             return resp
         return web.json_response(
             {"error": f"worker proxy to {target}: {e}"}, status=502)
+
+
+# frame-path proxy ceiling: bodies above this stream over the HTTP
+# hop (frames buffer one request per frame)
+FRAME_PROXY_MAX_BODY = 8 << 20
+
+
+def frame_eligible(req) -> bool:
+    """May this sibling-bound request ride the binary frame hop?
+    Needle-path methods only (admin tail/copy stream GBs and keep the
+    chunked HTTP hop), with a small declared body."""
+    if not re.match(r"^/\d+,", req.path):
+        return False
+    if req.method in ("GET", "HEAD"):
+        return True
+    if req.method in ("POST", "PUT"):
+        cl = req.headers.get("Content-Length", "")
+        return cl.isdigit() and int(cl) <= FRAME_PROXY_MAX_BODY
+    if req.method == "DELETE":
+        # normally bodyless (no Content-Length), but any declared
+        # body is buffered into ONE frame — cap it like writes, and
+        # refuse chunked (unsized) bodies outright, so an oversized
+        # payload can never emit a frame the peer's decoder must
+        # reject (tearing the multiplexed channel)
+        if "Transfer-Encoding" in req.headers:
+            return False
+        cl = req.headers.get("Content-Length", "") or "0"
+        return cl.isdigit() and int(cl) <= FRAME_PROXY_MAX_BODY
+    return False
+
+
+async def proxy_request_frame(req, ch):
+    """Frame-path twin of :func:`proxy_request`: one multiplexed frame
+    to the owning sibling instead of a full HTTP request. Hop-by-hop
+    (and hop-specific entity) headers are stripped in BOTH directions
+    exactly like the HTTP hop. Raises FrameChannelError/FrameFallback
+    for the caller's HTTP fallback — nothing has touched the client
+    connection yet at that point."""
+    from aiohttp import web
+    from ..util import tracing
+    headers = {k.lower(): v for k, v in req.headers.items()
+               if k.lower() not in _HOP_HEADERS
+               and k.lower() != "accept-encoding"}
+    if req.remote:
+        headers[FORWARDED_HEADER.lower()] = req.remote
+    # trace propagation: same discipline as the HTTP hop — the proxy
+    # span on the context parents the sibling's server span
+    tracing.inject(headers)
+    body = b""
+    if req.method not in ("GET", "HEAD"):
+        body = await req.read()
+    status, out_headers, payload = await ch.request(
+        req.method, req.path, query=dict(req.query), headers=headers,
+        body=body)
+    resp = web.Response(status=status, body=payload)
+    ct = None
+    for k, v in out_headers.items():
+        lk = k.lower()
+        if lk in _HOP_HEADERS or lk in _HOP_RESPONSE_EXTRA:
+            continue
+        if lk == "content-type":
+            ct = v
+            continue
+        resp.headers.add(k, v)
+    if ct:
+        resp.content_type = ct.partition(";")[0]
+        charset = ct.partition("charset=")[2].strip()
+        if charset:
+            resp.charset = charset
+    return resp
 
 
 class Supervisor:

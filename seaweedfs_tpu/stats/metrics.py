@@ -98,6 +98,19 @@ if HAVE_PROMETHEUS:
         "SeaweedFS_scrub_cycles_total",
         "completed whole-store scrub cycles",
         registry=REGISTRY)
+    # binary frame wire (util/frame.py): the intra-host sibling hop's
+    # request volume and its HTTP downgrades — a rising fallback rate
+    # means the frame path is being severed (chaos or a peer that
+    # predates the protocol)
+    FRAME_REQUESTS = Counter(
+        "SeaweedFS_frame_requests_total",
+        "frame-RPC requests, by side (client = issued, server = served)",
+        ["side"], registry=REGISTRY)
+    FRAME_FALLBACKS = Counter(
+        "SeaweedFS_frame_fallbacks_total",
+        "frame requests downgraded to the HTTP hop (server-advised "
+        "FLAG_FALLBACK answers + client-observed channel failures)",
+        registry=REGISTRY)
     # build/restart detection (scrapes and timelines both need to tell
     # a counter reset apart from a rate dip): every daemon exports who
     # it is and when this process was born

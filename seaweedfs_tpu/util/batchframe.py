@@ -82,3 +82,28 @@ def parse_all(blob: bytes) -> list[tuple[dict, bytes]]:
     if p.pending:
         raise ValueError("truncated batch payload")
     return out
+
+
+def parse_reads_spec(spec: str) -> "list[tuple[int, int, int]]":
+    """Parse the EC gather's ``sid:off:size,...`` spec — shared by the
+    HTTP and frame transports of /admin/ec/shard_read so the grammar
+    cannot drift between them. Raises ValueError on anything else."""
+    reads = [tuple(int(x) for x in part.split(":"))
+             for part in spec.split(",") if part]
+    if not reads or any(len(r) != 3 for r in reads):
+        raise ValueError("bad reads spec")
+    return reads
+
+
+def encode_shard_rows(reads, datas) -> bytes:
+    """Render the batched shard-read response rows ({shard, status}
+    meta + raw interval payload) — the one encoding both transports
+    serve."""
+    out = bytearray()
+    for (sid, _off, _size), data in zip(reads, datas):
+        if data is None:
+            out += encode_record({"shard": sid, "status": 404,
+                                  "error": "shard not found"})
+        else:
+            out += encode_record({"shard": sid, "status": 200}, data)
+    return bytes(out)

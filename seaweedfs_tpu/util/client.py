@@ -97,6 +97,10 @@ class WeedClient:
         self._neg_vids: dict[str, float] = {}
         from .chunk_cache import CacheCounters
         self._neg_counters = CacheCounters("lookup_neg")
+        # persistent multiplexed frame channels to volume servers
+        # (util/frame.py), lazily created by pipelined_read — reads
+        # are open over frames exactly like the HTTP listeners
+        self._frame_hub = None
 
     async def __aenter__(self) -> "WeedClient":
         if self._session is None:
@@ -104,6 +108,9 @@ class WeedClient:
         return self
 
     async def __aexit__(self, *exc) -> None:
+        if self._frame_hub is not None:
+            await self._frame_hub.close()
+            self._frame_hub = None
         if self._own and self._session:
             await self._session.close()
 
@@ -679,6 +686,106 @@ class WeedClient:
                         else:
                             # 406 manifest / transient 5xx: single GET
                             await fallback(fid)
+
+            await asyncio.gather(*(one_server(s, g)
+                                   for s, g in by_server.items()))
+            sp.status = "ok"
+            return {fid: result.get(fid) for fid in fids}
+        finally:
+            sp.finish()
+
+    @property
+    def frame_hub(self):
+        """Lazily-built cache of persistent multiplexed frame channels
+        (util/frame.FrameHub) — one per volume server this client has
+        pipelined against; closed with the session in __aexit__."""
+        if self._frame_hub is None:
+            from .frame import FrameHub
+            self._frame_hub = FrameHub(ssl=tls.client_ctx())
+        return self._frame_hub
+
+    async def pipelined_read(self, fids: list[str], depth: int = 8
+                             ) -> dict[str, bytes | None]:
+        """Pipelined multi-needle read: up to `depth` requests in
+        flight per keep-alive frame connection (util/frame.py), so a
+        needle costs tens of bytes of protocol overhead and no
+        per-request round-trip wait — responses complete out of order
+        and the socket stays full. Complements batch_read: /batch
+        amortizes one response over many needles, pipelining overlaps
+        many independent responses (and never waits for the slowest
+        row in a batch).
+
+        Any channel-level failure (peer predates the frame protocol,
+        severed connection, FLAG_FALLBACK row) silently downgrades
+        that fid to the resilient single-GET HTTP path. Cache-aware
+        exactly like batch_read: hits skip the network, fills are
+        fence-tokened. A fid that ultimately can't be read maps to
+        None."""
+        from .frame import FrameChannelError
+        result: dict[str, bytes | None] = {}
+        cc = self.chunk_cache
+        by_server: dict[str, list[str]] = {}
+        sp = tracing.start("client", "pipelined_read", n=len(fids),
+                           depth=depth)
+        try:
+            for fid in dict.fromkeys(fids):   # dedup, order-stable
+                if cc is not None:
+                    data = await self._cc_get(fid)
+                    if data is not None:
+                        result[fid] = data
+                        continue
+                try:
+                    locs = await self.lookup(fid.split(",")[0])
+                except OperationError:
+                    result[fid] = None
+                    continue
+                url = locs[0].get("publicUrl", locs[0].get("url", ""))
+                by_server.setdefault(url, []).append(fid)
+
+            async def fallback(fid: str) -> None:
+                try:
+                    result[fid] = await self.read(fid)
+                except OperationError:
+                    result[fid] = None
+
+            async def one_server(server: str, group: list[str]) -> None:
+                ch = self.frame_hub.get(target=server)
+                sem = asyncio.Semaphore(max(1, depth))
+                fell_back = 0
+
+                async def one(fid: str) -> None:
+                    nonlocal fell_back
+                    token = cc.fill_token(fid) if cc is not None \
+                        else None
+                    async with sem:
+                        try:
+                            await failpoints.fail("client.pipeline")
+                            status, _, body = await ch.request(
+                                "GET", "/" + fid)
+                        except (FrameChannelError, OSError):
+                            # dead channel / FLAG_FALLBACK / injected
+                            # fault: this fid rides the HTTP path
+                            fell_back += 1
+                            await fallback(fid)
+                            return
+                    if status == 200:
+                        if cc is not None:
+                            if cc.has_disk:
+                                await tracing.run_in_executor(
+                                    cc.set_if, fid, body, token)
+                            else:
+                                cc.set_if(fid, body, token)
+                        result[fid] = body
+                    elif status == 404:
+                        result[fid] = None
+                    else:
+                        # 406 manifest / transient 5xx: single GET
+                        await fallback(fid)
+
+                await asyncio.gather(*(one(f) for f in group))
+                if fell_back:
+                    sp.event("pipeline_fallback", server=server,
+                             n=fell_back)
 
             await asyncio.gather(*(one_server(s, g)
                                    for s, g in by_server.items()))

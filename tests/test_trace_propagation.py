@@ -286,3 +286,69 @@ def test_trace_sample_zero_records_nothing(tmp_path):
             assert tracing.traces_dict()["spans"] == 0
             assert tracing.requests_dict()["inflight"] == 0
     run(go())
+
+
+def test_frame_hop_stays_one_trace_with_transport_attr(tmp_path):
+    """The binary sibling wire keeps the cross-worker hop in ONE
+    trace: the proxy span carries transport=frame, the owner-side
+    volume span (minted by the frame adapter) chains under it — and
+    with worker.frame armed, the SAME read downgrades to the HTTP hop
+    (transport=http + frame_fallback event), bytes identical."""
+    async def go():
+        async with Cluster(str(tmp_path), n_servers=0) as c:
+            workers = await _start_worker_fleet(c, tmp_path)
+            try:
+                # a fid on an ODD vid: reads entering worker 0 must hop
+                fid = body = None
+                for _ in range(16):
+                    a = await c.assign()
+                    if int(a["fid"].split(",")[0]) % 2 == 1:
+                        fid, body = a["fid"], b"frame-hop-trace" * 100
+                        st, _ = await c.put(fid, workers[0].url, body)
+                        assert st == 201
+                        break
+                assert fid is not None, "no odd-vid assign in 16 tries"
+
+                tracing.reset()
+                trace_id = "ef" * 16
+                tp = f"00-{trace_id}-{'ad' * 8}-01"
+                async with c.http.get(f"http://{workers[0].url}/{fid}",
+                                      headers={"traceparent": tp}) as r:
+                    assert r.status == 200
+                    assert await r.read() == body
+                g = [t for t in tracing.traces_dict(recent=100)["traces"]
+                     if t["trace_id"] == trace_id][0]
+                proxy = [s for s in g["spans"]
+                         if s["tier"] == "proxy"][0]
+                assert proxy["attrs"]["transport"] == "frame", proxy
+                owner_vol = [s for s in g["spans"]
+                             if s["parent"] == proxy["span"]]
+                assert owner_vol and owner_vol[0]["tier"] == "volume"
+                assert owner_vol[0]["attrs"]["transport"] == "frame"
+                # the store span chains under the frame-served read
+                store = [s for s in g["spans"] if s["tier"] == "store"]
+                assert store and store[0]["parent"] == \
+                    owner_vol[0]["span"]
+
+                # sever the frame hop: same read, same bytes, but the
+                # proxy span records the downgrade
+                fp.arm("worker.frame", "error")
+                tracing.reset()
+                trace_id2 = "f0" * 16
+                tp2 = f"00-{trace_id2}-{'ad' * 8}-01"
+                async with c.http.get(f"http://{workers[0].url}/{fid}",
+                                      headers={"traceparent": tp2}) as r:
+                    assert r.status == 200
+                    assert await r.read() == body
+                g2 = [t for t in
+                      tracing.traces_dict(recent=100)["traces"]
+                      if t["trace_id"] == trace_id2][0]
+                proxy2 = [s for s in g2["spans"]
+                          if s["tier"] == "proxy"][0]
+                assert proxy2["attrs"]["transport"] == "http", proxy2
+                assert any(e["name"] == "frame_fallback"
+                           for e in proxy2.get("events", [])), proxy2
+            finally:
+                for vs in workers:
+                    await vs.stop()
+    run(go())

@@ -246,14 +246,29 @@ def test_sendfile_cold_read_zero_copy(tmp_path):
                      for s in tr["spans"]
                      if s.get("attrs", {}).get("source") == "sendfile"]
             assert spans, "no sendfile-attributed span recorded"
-            # buffered twin (cold header -> aiohttp; drop cache first)
+            # aiohttp twin (cold header upgrades the connection): the
+            # app now drains the same NeedleRef via StreamResponse +
+            # loop.sendfile — identical bytes/ETag, and the span still
+            # says source=sendfile through THIS listener too
             vs.store.drop_cached_volume(
                 int(fid.split(",")[0]))
+            tracing.reset()
             st2, hs2, got2 = await _get(vs.port, f"/{fid}", host,
                                         cold=True)
             assert st2 == 200 and got2 == payload
             assert hs["etag"] == hs2["etag"]
             assert hs["content-length"] == hs2["content-length"]
+            spans2 = [s for tr in tracing.traces_dict(
+                          recent=50, slowest=0)["traces"]
+                      for s in tr["spans"]
+                      if s.get("attrs", {}).get("source") == "sendfile"]
+            assert spans2, "aiohttp read did not take the ref path"
+            # ranged aiohttp sendfile: kernel copy sliced
+            vs.store.drop_cached_volume(int(fid.split(",")[0]))
+            st2r, _, got2r = await _get(
+                vs.port, f"/{fid}", host,
+                extra="Range: bytes=90000-\r\n", cold=True)
+            assert st2r == 206 and got2r == payload[90000:]
             # ranged sendfile: slice of the data region
             vs.store.drop_cached_volume(int(fid.split(",")[0]))
             st3, hs3, got3 = await _get(

@@ -306,23 +306,47 @@ class _Fleet:
         return self
 
     def __exit__(self, *exc) -> None:
+        # worker pids come from the ON-DISK state files, captured
+        # before the fleet dies — asking the (dying) HTTP surface used
+        # to silently return [] and skip the wait, leaving orphan
+        # workers heartbeating the port for a second or two and
+        # poisoning the NEXT fleet's master topology with zombie
+        # nodes (its /vol/grow then 500s against a dead private url)
+        pids: list[int] = []
+        state_dir = os.path.join(self.tmp, "v", ".workers")
+        try:
+            for fn in os.listdir(state_dir):
+                if not fn.endswith(".json"):
+                    continue
+                try:
+                    with open(os.path.join(state_dir, fn)) as f:
+                        pid = json.load(f).get("pid")
+                except (OSError, ValueError):
+                    continue
+                if pid:
+                    pids.append(int(pid))
+        except OSError:
+            pass
         for p in self.procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGKILL)
         for p in self.procs:
             p.wait(timeout=10)
-        # SIGKILLing the supervisor orphans the workers; they watch
-        # their parent pid and exit on their own — wait for that
-        for w in self.worker_rows():
-            pid = w.get("pid")
-            if not pid:
-                continue
+        # SIGKILL the orphaned workers too (they would exit on their
+        # own after noticing the dead supervisor, but not before
+        # heartbeating a reused port), then wait until they are gone
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        for pid in pids:
             for _ in range(40):
                 try:
                     os.kill(pid, 0)
                 except OSError:
                     break
-                time.sleep(0.2)
+                time.sleep(0.1)
 
     def worker_rows(self) -> list[dict]:
         try:

@@ -20,6 +20,16 @@ single-core regressions and multi-core scaling are one command:
                                                  # across workers) and
                                                  # print the per-tier
                                                  # latency breakdown
+    python tools/bench_needle.py pipeline 1      # depth-8 multiplexed
+                                                 # frame reads vs single
+                                                 # GETs (round-12 A/B)
+    python tools/bench_needle.py hop             # deterministic sibling-
+                                                 # hop accounting, HTTP
+                                                 # vs frame: overhead
+                                                 # bytes + round trips on
+                                                 # the zipf batch mix
+                                                 # (wall-clock stays
+                                                 # informational)
 
 Prints one JSON line per configuration:
     {"workers": 1, "write_rps": ..., "read_rps": ...}
@@ -51,6 +61,8 @@ BASE_PORT = 21700
 
 _RPS = re.compile(r"^(write|read):\s+([0-9.]+) req/s", re.M)
 _NEEDLES = re.compile(r"needles/s: ([0-9.]+) \(batch=(\d+)")
+_PIPE = re.compile(r"needles/s: ([0-9.]+) \(pipeline=(\d+) over "
+                   r"frames, (\d+) HTTP fallbacks\)")
 
 
 def _wait_assign(master: str, tries: int = 60) -> None:
@@ -89,8 +101,9 @@ def _needle_cache_hit_rate(vol: str) -> "tuple[float, float] | None":
 def bench_one(workers: int, n: int, size: int, conc: int,
               cache_mb: "int | None" = None,
               read_mode: str = "", read_n: int = 0,
-              batch_size: int = 0,
-              trace: bool = False) -> dict:
+              batch_size: int = 0, pipeline: int = 0,
+              trace: bool = False,
+              scrape_frames: bool = False) -> dict:
     tmp = tempfile.mkdtemp(prefix=f"swtpu_bn_w{workers}_")
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     procs: list[subprocess.Popen] = []
@@ -128,6 +141,8 @@ def bench_one(workers: int, n: int, size: int, conc: int,
             bench += ["-readN", str(read_n)]
         if batch_size:
             bench += ["-batchSize", str(batch_size)]
+        if pipeline:
+            bench += ["-pipeline", str(pipeline)]
         out = subprocess.run(bench, capture_output=True, text=True,
                              env=env, cwd=tmp, timeout=1800).stdout
         rates = dict(_RPS.findall(out))
@@ -142,11 +157,33 @@ def bench_one(workers: int, n: int, size: int, conc: int,
                 # batch rows read_rps counts WIRE requests, not needles
                 row["needles_rps"] = float(m.group(1))
                 row["batch"] = int(m.group(2))
+        if pipeline:
+            m = _PIPE.search(out)
+            if m:
+                row["needles_rps"] = float(m.group(1))
+                row["pipeline"] = int(m.group(2))
+                row["frame_fallbacks"] = int(m.group(3))
         if read_mode:
             row["read_mode"] = read_mode
             row["reads"] = read_n or n
         if cache_mb is not None:
             row["cache"] = "off" if cache_mb == 0 else "on"
+        if scrape_frames:
+            # live sibling frame channel counters (whole-host /status
+            # merge): every number is a plain event/byte count
+            try:
+                with urllib.request.urlopen(
+                        f"http://{vol_addr}/status", timeout=10) as r:
+                    frames = json.load(r).get("frames", {})
+                agg: dict = {}
+                for per_w in frames.values():
+                    for chs in per_w.values():
+                        for k, v in chs.items():
+                            agg[k] = agg.get(k, 0) + v
+                if agg:
+                    row["sibling_frames"] = agg
+            except (OSError, ValueError):
+                pass
         hm = _needle_cache_hit_rate(vol_addr)
         if hm is not None and sum(hm) > 0:
             row["hit_rate"] = round(hm[0] / (hm[0] + hm[1]), 4)
@@ -194,16 +231,141 @@ def bench_one(workers: int, n: int, size: int, conc: int,
         time.sleep(1)   # workers notice the dead supervisor and exit
 
 
+def hop_accounting(n_files: int = 2000, reads: int = 6000,
+                   batch: int = 32, depth: int = 8,
+                   seed: int = 9) -> dict:
+    """Deterministic sibling-hop accounting, HTTP vs frame, on the
+    zipf batch mix — every number is computed from the REAL codecs
+    over a seeded workload, so two runs produce identical output (no
+    wall-clock anywhere).
+
+    Workload: `reads` zipf-ordered needle reads over `n_files` fids
+    spread across both vid-parity partitions, grouped into /batch
+    requests of `batch`, entering at worker 0 — each batch's odd-vid
+    rows cross the sibling hop as ONE sub-request.
+
+    Accounting per sub-request:
+      * frame overhead  = the REAL encoded frame bytes minus payload
+        (util/frame.encode_frame, via overhead_model);
+      * HTTP overhead   = the request line + headers the HTTP hop
+        sends (worker token, traceparent, aiohttp's standard headers)
+        plus the raw listener's response head — same fids string on
+        both sides, so the delta is pure protocol framing.
+
+    Round trips count serialized response-waits for the single-GET
+    shape of the same zipf mix: HTTP/1.1 keep-alive blocks its
+    connection per request (one wait per needle); a depth-N frame
+    channel overlaps N (one wait per window) — the client-pipelining
+    half of the PR."""
+    import random
+    sys.path.insert(0, REPO)
+    from seaweedfs_tpu.util import batchframe
+    from seaweedfs_tpu.util.frame import overhead_model
+    from seaweedfs_tpu.server.workers import WORKER_HEADER
+
+    rng = random.Random(seed)
+    fids = [f"{(i % 10) + 1},{i:016x}35c2" for i in range(n_files)]
+    ranked = list(fids)
+    rng.shuffle(ranked)
+    weights = [1.0 / (r + 1) ** 1.1 for r in range(len(ranked))]
+    order = rng.choices(ranked, weights=weights, k=reads)
+    token = "ab" * 16                 # launch tokens are 32 hex chars
+    tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+
+    http_over = frame_over = sub_requests = sib_needles = 0
+    spec_bytes = 0                    # the fids string, same both sides
+    for lo in range(0, len(order), batch):
+        group = order[lo:lo + batch]
+        sib = [f for f in group if int(f.split(",")[0]) % 2 == 1]
+        if not sib:
+            continue
+        sub_requests += 1
+        sib_needles += len(sib)
+        q = ",".join(sib)
+        spec_bytes += len(q)
+        frame_over += overhead_model(
+            "GET", "/batch", query={"fids": q},
+            headers={"traceparent": tp},
+            resp_headers={}, resp_ct=batchframe.CONTENT_TYPE)
+        req_head = (f"GET /batch?fids={q} HTTP/1.1\r\n"
+                    f"Host: 127.0.0.1:20000\r\n"
+                    f"{WORKER_HEADER}: {token}\r\n"
+                    f"traceparent: {tp}\r\n"
+                    f"Accept: */*\r\n"
+                    f"Accept-Encoding: gzip, deflate\r\n"
+                    f"User-Agent: Python/3.10 aiohttp/3.8\r\n\r\n")
+        resp_head = (f"HTTP/1.1 200 OK\r\n"
+                     f"Content-Type: {batchframe.CONTENT_TYPE}\r\n"
+                     f"Content-Length: 1048576\r\n\r\n")
+        http_over += len(req_head) + len(resp_head)
+
+    http_rts = reads                  # one blocking wait per needle
+    frame_rts = -(-reads // depth)    # one wait per depth-N window
+    return {
+        "mode": "hop", "reads": reads, "batch": batch,
+        "sibling_sub_requests": sub_requests,
+        "sibling_needles": sib_needles,
+        # the fids spec rides both transports identically; protocol_*
+        # rows subtract it so the framing cost itself is visible
+        "fids_spec_bytes": spec_bytes,
+        "http": {"overhead_bytes": http_over,
+                 "per_needle": round(http_over / sib_needles, 2),
+                 "protocol_per_needle": round(
+                     (http_over - spec_bytes) / sib_needles, 2),
+                 "single_get_round_trips": http_rts},
+        "frame": {"overhead_bytes": frame_over,
+                  "per_needle": round(frame_over / sib_needles, 2),
+                  "protocol_per_needle": round(
+                      (frame_over - spec_bytes) / sib_needles, 2),
+                  "pipelined_round_trips": frame_rts,
+                  "pipeline_depth": depth},
+    }
+
+
 def main() -> None:
     args = sys.argv[1:]
     zipf = "zipf" in args
     batch = "batch" in args
+    pipeline = "pipeline" in args
+    hop = "hop" in args
     trace = "trace" in args
     sweep = [int(a) for a in args if a.isdigit()] or (
         [1] if zipf or batch else [1, 2])
     n = int(os.environ.get("SWTPU_BENCH_N", "10000"))
     size = int(os.environ.get("SWTPU_BENCH_SIZE", "1024"))
     conc = int(os.environ.get("SWTPU_BENCH_C", "64"))
+    if hop:
+        # deterministic sibling-hop accounting (the acceptance gate:
+        # frames strictly cheaper per needle, fewer round trips) ...
+        acct = hop_accounting()
+        print(json.dumps(acct), flush=True)
+        assert acct["frame"]["overhead_bytes"] < \
+            acct["http"]["overhead_bytes"], "frame overhead not lower"
+        assert acct["frame"]["pipelined_round_trips"] < \
+            acct["http"]["single_get_round_trips"], \
+            "frame round trips not fewer"
+        # ... plus one LIVE -workers 2 zipf batch run: wall-clock
+        # informational (±2x container band, PERF.md round 8), the
+        # scraped sibling frame channel counters are the real-wire
+        # confirmation of the model
+        read_n = int(os.environ.get("SWTPU_BENCH_READN", str(2 * n)))
+        print(json.dumps(bench_one(
+            2, n, size, conc, cache_mb=32, read_mode="zipf",
+            read_n=read_n, batch_size=32, trace=trace,
+            scrape_frames=True)), flush=True)
+        return
+    if pipeline:
+        # round-12 A/B: depth-8 multiplexed frame reads vs single
+        # GETs over the same zipf order, cache on
+        read_n = int(os.environ.get("SWTPU_BENCH_READN", str(3 * n)))
+        depth = int(os.environ.get("SWTPU_BENCH_PIPELINE", "8"))
+        for w in sweep:
+            for d in (depth, 0):
+                print(json.dumps(bench_one(
+                    w, n, size, conc, cache_mb=32,
+                    read_mode="zipf", read_n=read_n,
+                    pipeline=d, trace=trace)), flush=True)
+        return
     if batch:
         # round-9 A/B: multi-needle /batch vs single GET, zipf +
         # uniform read orders, cache on (the production shape)

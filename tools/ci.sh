@@ -63,7 +63,7 @@ if ! python -m tools.weedlint tests \
 fi
 python -m tools.weedlint tests --report-only --no-baseline | tail -n 1
 
-echo "== wire smoke (batch GET + group commit + sendfile, live volume) =="
+echo "== wire smoke (batch + group commit + sendfile + frame hop) =="
 if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/wire_smoke.py; then
     echo "wire smoke: FAILED (data-plane regression — see output above)"
     exit 1

@@ -666,7 +666,8 @@ async def scenario_cache_churn(tmp: str) -> int:
         locks: dict = {}
         deleted: set = set()
         stats = {"reads": 0, "stale": 0, "transient": 0,
-                 "overwrites": 0, "deletes": 0, "batched": 0}
+                 "overwrites": 0, "deletes": 0, "batched": 0,
+                 "pipelined": 0}
         async with WeedClient(
                 master, chunk_cache=await asyncio.to_thread(TieredChunkCache, 8 << 20)) as c:
             await fill(c, payloads, n_files, rng, replication="000")
@@ -690,6 +691,16 @@ async def scenario_cache_churn(tmp: str) -> int:
             await asyncio.to_thread(
                 _failpoints, vport, "POST",
                 "?site=volume.read.http&spec=latency=10@0.05")
+            # sever a slice of the binary frame hop too: pipelined
+            # reads and the sibling frame proxy must fall back to
+            # HTTP without a single stale/lost byte. Armed BOTH on the
+            # servers (the worker-to-worker frame forward) and in this
+            # process (the client's own channels).
+            await asyncio.to_thread(
+                _failpoints, vport, "POST",
+                "?site=worker.frame&spec=error@0.05")
+            from seaweedfs_tpu.util import failpoints as _fp
+            _fp.arm("worker.frame", "error@0.05")
             stop_at = time.time() + duration
 
             async def reader() -> None:
@@ -751,6 +762,38 @@ async def scenario_cache_churn(tmp: str) -> int:
                                       f"{len(want[f])}B")
                                 stats["stale"] += 1
 
+            async def pipeline_reader() -> None:
+                # a fraction of traffic rides the binary frame wire
+                # (multiplexed pipelined reads) with worker.frame
+                # faults armed: every severed request must downgrade
+                # to HTTP and still return current bytes
+                import contextlib
+                while time.time() < stop_at:
+                    group = sorted({pick() for _ in range(4)})
+                    async with contextlib.AsyncExitStack() as held:
+                        for f in group:
+                            await held.enter_async_context(locks[f])
+                        want = {f: payloads.get(f) for f in group}
+                        got = await c.pipelined_read(group, depth=4)
+                        for f in group:
+                            g = got.get(f)
+                            if g is None:
+                                if f not in deleted:
+                                    stats["transient"] += 1
+                                continue
+                            stats["reads"] += 1
+                            stats["pipelined"] += 1
+                            if want[f] is None:
+                                print(f"  STALE: pipelined read of "
+                                      f"deleted {f} returned "
+                                      f"{len(g)} bytes")
+                                stats["stale"] += 1
+                            elif g != want[f]:
+                                print(f"  STALE: pipelined {f} "
+                                      f"returned {len(g)}B != "
+                                      f"expected {len(want[f])}B")
+                                stats["stale"] += 1
+
             async def overwriter() -> None:
                 while time.time() < stop_at:
                     fid = pick()
@@ -787,15 +830,18 @@ async def scenario_cache_churn(tmp: str) -> int:
 
             await asyncio.gather(*[reader() for _ in range(4)],
                                  *[batch_reader() for _ in range(2)],
+                                 *[pipeline_reader() for _ in range(2)],
                                  *[overwriter() for _ in range(2)],
                                  deleter())
             await asyncio.to_thread(_failpoints, vport, "DELETE")
             print(f"  churn: {stats['reads']} verified reads "
-                  f"({stats['batched']} via /batch), "
+                  f"({stats['batched']} via /batch, "
+                  f"{stats['pipelined']} pipelined over frames), "
                   f"{stats['overwrites']} overwrites, "
                   f"{stats['deletes']} deletes, "
                   f"{stats['transient']} transient errors, "
                   f"{stats['stale']} stale")
+            _fp.reset()
             # quiescent final sweep: every live file byte-exact, every
             # deleted fid a clean 404 (lost/stale both count as bad)
             bad = await verify(c, payloads, "after cache churn")

@@ -8,7 +8,11 @@ drives the unified wire end to end over raw sockets:
   2. batch GET round trip — hot + cold + missing fids, order and bytes
      verified against single GETs;
   3. sendfile read — a large cold needle byte-verified against the
-     buffered path, Range resume included.
+     buffered path, Range resume included;
+  4. binary frame hop — a second master + `-workers 2` volume fleet:
+     single reads and one cross-partition /batch driven over the
+     frame protocol, byte-equal with the SAME requests over HTTP,
+     with the sibling frame channels asserted in use via /status.
 
 Data-plane regressions fail here in seconds, before tier-1 runs.
 """
@@ -151,6 +155,71 @@ def main() -> int:
             c.close()
         print(f"  sendfile: {len(payload)}-byte cold body + ranged "
               f"resume byte-verified over the raw listener")
+
+        # -- 4. binary frame hop on a -workers 2 fleet ------------------
+        m2 = f"127.0.0.1:{PORT + 2}"
+        v2 = f"127.0.0.1:{PORT + 3}"
+        spawn("master", "-port", str(PORT + 2), "-mdir",
+              os.path.join(tmp, "m2"), "-pulseSeconds", "1")
+        time.sleep(1.5)
+        spawn("volume", "-port", str(PORT + 3), "-dir",
+              os.path.join(tmp, "v2"), "-max", "10", "-master", m2,
+              "-pulseSeconds", "1", "-workers", "2")
+        wait_assign(m2)
+        # grow past one volume so assigns cover BOTH vid-parity
+        # partitions (vid % 2 owns the worker)
+        with urllib.request.urlopen(f"http://{m2}/vol/grow?count=4",
+                                    timeout=10) as r:
+            r.read()
+        fleet_fids: dict = {}
+        vids = set()
+        for i in range(32):
+            a = assign(m2)
+            vid = int(a["fid"].split(",")[0])
+            body = f"frame-hop-{i}-".encode() * 50
+            st, _, out = req(a["url"], "POST", "/" + a["fid"], body)
+            assert st == 201, (st, out[:120])
+            fleet_fids[a["fid"]] = body
+            vids.add(vid % 2)
+            if len(fleet_fids) >= 4 and len(vids) == 2:
+                break
+        assert len(vids) == 2, "assigns never covered both partitions"
+
+        import asyncio
+
+        async def frame_phase() -> None:
+            from seaweedfs_tpu.util.frame import FrameChannel
+            ch = FrameChannel(target=v2)
+            try:
+                # single reads over frames: whichever worker accepted
+                # the connection forwards other-parity vids over its
+                # sibling frame channel — byte-equal with HTTP
+                for fid, want in fleet_fids.items():
+                    fst, _, fbody = await ch.request("GET", "/" + fid)
+                    hst, _, hbody = req(v2, "GET", "/" + fid)
+                    assert fst == hst == 200, (fid, fst, hst)
+                    assert fbody == hbody == want, fid
+                # one cross-partition batch over frames vs HTTP
+                ask = ",".join(fleet_fids)
+                fst, _, fraw = await ch.request(
+                    "GET", "/batch", query={"fids": ask})
+                hst, _, hraw = req(v2, "GET", "/batch?fids=" + ask)
+                assert fst == hst == 200, (fst, hst)
+                assert fraw == hraw, "frame/HTTP batch bytes differ"
+            finally:
+                await ch.close()
+
+        asyncio.run(frame_phase())
+        st, _, out = req(v2, "GET", "/status")
+        frames = json.loads(out).get("frames", {})
+        hop_requests = sum(chs["requests"]
+                           for per_w in frames.values()
+                           for chs in per_w.values())
+        assert hop_requests > 0, \
+            f"sibling frame channels never used: {frames}"
+        print(f"  frame hop: {len(fleet_fids)} single reads + 1 "
+              f"cross-partition batch byte-equal over frames vs HTTP "
+              f"({hop_requests} sibling frame requests)")
         print("wire smoke: OK")
         return 0
     finally:
