@@ -193,6 +193,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="park the scrubber while any foreground "
                         "request in the last 2s ran longer than this "
                         "many ms; 0 never pauses")
+    # default None -> ec/batch.py DEFAULT_BATCH_WINDOWS (8), resolved
+    # in VolumeServer: importing the engine (numpy) here would tax
+    # EVERY CLI command's startup for one volume-only constant
+    v.add_argument("-scrub.batch", dest="scrub_batch", type=int,
+                   default=None,
+                   help="stripe windows verified per scrub GF-transform "
+                        "dispatch (default 8, the stripe-batch engine's "
+                        "width, clamped so one block stays inside the "
+                        "resident-byte budget); the byte budget and "
+                        "foreground pause still gate every block; 1 "
+                        "restores the per-window shape")
+    v.add_argument("-ec.smallrecover", dest="ec_smallrecover", type=int,
+                   default=1 << 20,
+                   help="EC recover transforms smaller than this many "
+                        "bytes run on the host CPU encoder instead of "
+                        "the device (dispatch-latency crossover; "
+                        "tools/bench_ec.py --mode bakeoff prints the "
+                        "measured value so this default stays honest)")
 
     f = sub.add_parser("filer", help="start a filer server")
     _add_common(f)
@@ -705,7 +723,8 @@ async def _run_volume(args) -> None:
                    (worker_ctx.index, worker_ctx.total)),
         needle_cache_bytes=args.cache_mem * 1024 * 1024,
         group_commit_window=args.groupcommit_ms / 1000.0,
-        fsync=args.fsync))
+        fsync=args.fsync,
+        ec_small_recover_bytes=args.ec_smallrecover))
     vs = VolumeServer(store, args.master, ip=args.ip, port=args.port,
                       data_center=args.dataCenter, rack=args.rack,
                       pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
@@ -715,7 +734,8 @@ async def _run_volume(args) -> None:
                       batch_max=args.batch_max,
                       scrub_mbps=args.scrub_mbps,
                       scrub_interval=args.scrub_interval,
-                      scrub_pause_ms=args.scrub_pause_ms)
+                      scrub_pause_ms=args.scrub_pause_ms,
+                      scrub_batch=args.scrub_batch)
     await vs.start()
     rec = _start_recorder(disk_paths=dirs)
     if worker_ctx is not None:

@@ -119,7 +119,8 @@ class EcVolume:
                  fetch_remote_batch=None,
                  recover_cache=None,
                  holder_peek=None,
-                 refresh_holders=None):
+                 refresh_holders=None,
+                 small_recover_bytes: int | None = None):
         self.dir = dirname
         self.collection = collection
         self.vid = vid
@@ -148,6 +149,13 @@ class EcVolume:
         # failed batch gather
         self.holder_peek = holder_peek
         self.refresh_holders = refresh_holders
+        # device-vs-host recover crossover (-ec.smallrecover): below
+        # this, a recover transform is dispatch-latency-bound and the
+        # host path wins; tools/bench_ec.py measures the live value so
+        # the default stays honest
+        self.small_recover_bytes = (self.SMALL_RECOVER_BYTES
+                                    if small_recover_bytes is None
+                                    else int(small_recover_bytes))
         # per-(missing-set) repair plans; invalidated on shard
         # mount/unmount and holder-map refresh
         self._plans: dict[frozenset, RepairPlan] = {}
@@ -195,14 +203,15 @@ class EcVolume:
 
     # below this, a recover transform is dispatch-latency-bound and the
     # host AVX2/numpy path beats a device round trip (store_ec.go always
-    # pays the CPU cost; we pay it only where it wins)
+    # pays the CPU cost; we pay it only where it wins). The DEFAULT for
+    # the measured, per-volume `small_recover_bytes` (-ec.smallrecover)
     SMALL_RECOVER_BYTES = 1 << 20
 
     def encoder(self, interval_size: int | None = None):
         if self._encoder is not None:  # explicit injection always wins
             return self._encoder
         if (interval_size is not None
-                and interval_size < self.SMALL_RECOVER_BYTES):
+                and interval_size < self.small_recover_bytes):
             if self._small_encoder is None:
                 from .encoder_cpu import CpuEncoder
                 self._small_encoder = CpuEncoder()
@@ -430,15 +439,18 @@ class EcVolume:
                         rc.put((self.vid, sid, offset, size), b)
             return data
 
-    def verify_window(self, offset: int, size: int,
-                      strict: bool = False) -> bool:
-        """Recompute RS(10,4) parity over ONE stripe window and compare
-        against the stored parity rows — the scrub unit, paced
-        window-by-window by ec/scrub.py's token bucket. Reads all 14
-        rows (local preferred; missing rows come via remote fetch).
+    def read_window_block(self, offset: int, count: int, size: int,
+                          strict: bool = False,
+                          stats: dict | None = None) -> np.ndarray:
+        """Gather `count` consecutive stripe windows of `size` bytes
+        into one (count, 14, size) uint8 block — the scrub unit of the
+        stripe-batch engine. ONE pread (or one remote fetch) per shard
+        covers the whole block; rows past shard EOF read as zeros, and
+        since the stored parity there is zeros too, padded tail windows
+        verify clean by construction.
 
         strict=True (the scrubber) refuses to substitute a
-        RECONSTRUCTED row when a holder stops serving mid-window:
+        RECONSTRUCTED row when a holder stops serving mid-cycle:
         parity recomputed from rows derived from the other rows
         matches trivially, so a 'clean' verdict would claim evidence
         about bytes that were never examined — the unreachable shard
@@ -447,30 +459,70 @@ class EcVolume:
         verify_parity semantics (recovered rows allowed, flagged
         volume-wide via used_recovered_rows).
 
-        The `scrub.read` failpoint (action `flip`) corrupts rows here
-        — the injection point the scrub soak uses to prove planted
-        corruption is detected while foreground reads stay clean."""
+        The `scrub.read` failpoint (action `flip`) corrupts rows here,
+        applied per WINDOW row exactly like the pre-batching path (one
+        potential fire per window per shard) — the injection point the
+        scrub soak uses to prove planted corruption is detected while
+        foreground reads stay clean."""
+        nbytes = count * size
         rows = []
+        local_preads = 0
+        remote_rows = 0
         for sid in range(gf.TOTAL_SHARDS):
+            if sid in self.shards:
+                local_preads += 1
+            else:
+                remote_rows += 1
             if strict and sid not in self.shards:
-                data = (self.fetch_remote(sid, offset, size)
+                data = (self.fetch_remote(sid, offset, nbytes)
                         if self.fetch_remote is not None else None)
                 if data is None:
                     raise EcVolumeError(
                         f"shard {sid} unreachable mid-scrub: window "
                         f"{offset} has no evidence for it")
             else:
-                data = self._read_shard_interval(sid, offset, size)
-            if failpoints.armed():
-                data = failpoints.corrupt("scrub.read", data)
-                if len(data) != size:  # truncate armed: keep row shape
-                    data = data[:size] + b"\x00" * (size - len(data))
-            rows.append(np.frombuffer(data, np.uint8))
-        enc = self.encoder(size)
-        from .encoder_cpu import CpuEncoder
-        if isinstance(enc, CpuEncoder):
-            return enc.verify(rows)
-        return bool(enc.verify(np.stack(rows)))
+                data = self._read_shard_interval(sid, offset, nbytes)
+            if len(data) < nbytes:
+                data = data + b"\x00" * (nbytes - len(data))
+            rows.append(np.frombuffer(data, np.uint8).reshape(count, size))
+        from .batch import add_stat
+        # preads = LOCAL shard reads only; rows served by a peer (or
+        # reconstructed) are accounted as remote_rows — a degraded
+        # volume's verify report must not claim disk reads it never did
+        add_stat(stats, preads=local_preads, remote_rows=remote_rows,
+                 bytes_read=nbytes * gf.TOTAL_SHARDS)
+        block = np.stack(rows, axis=1)
+        if failpoints.armed():
+            # window-major, sid-ascending — the exact fire order of the
+            # pre-batching per-window path, so `flip:N` grammars plant
+            # corruption in the same windows batched or not
+            for w in range(count):
+                for sid in range(gf.TOTAL_SHARDS):
+                    d = failpoints.corrupt("scrub.read",
+                                           block[w, sid].tobytes())
+                    if len(d) != size:  # truncate armed: keep row shape
+                        d = d[:size] + b"\x00" * (size - len(d))
+                    block[w, sid] = np.frombuffer(d, np.uint8)
+        return block
+
+    def verify_window_block(self, offset: int, count: int, size: int,
+                            strict: bool = False,
+                            stats: dict | None = None) -> list[bool]:
+        """Recompute RS(10,4) parity over `count` consecutive stripe
+        windows in ONE batched transform dispatch and compare against
+        the stored parity rows -> per-window verdicts. Every backend
+        answers through the same `verify_batch(block)` surface — no
+        per-encoder branching."""
+        from .batch import verify_block
+        block = self.read_window_block(offset, count, size, strict, stats)
+        return verify_block(self.encoder(count * size), block, stats)
+
+    def verify_window(self, offset: int, size: int,
+                      strict: bool = False) -> bool:
+        """One-window verify — the count=1 case of
+        verify_window_block (the scrub unit before stripe batching;
+        kept as the /admin and test-facing primitive)."""
+        return self.verify_window_block(offset, 1, size, strict)[0]
 
     def missing_shards(self) -> list[int]:
         """Shards neither local nor remotely fetchable (they verify via
@@ -480,28 +532,46 @@ class EcVolume:
                 and (self.fetch_remote is None
                      or self.fetch_remote(sid, 0, 1) is None)]
 
-    def verify_parity(self, window_size: int = 4 << 20) -> dict:
+    def verify_parity(self, window_size: int = 4 << 20,
+                      batch_windows: int | None = None) -> dict:
         """Scrub: recompute RS(10,4) parity over every stripe window and
         compare against the stored parity shards — a whole-volume
         bit-rot check that runs as the same GF(256) device transform the
         encoder uses (the reference has no equivalent; its integrity
         stops at per-needle CRCs on read, needle/crc.go).
 
-        Missing local shards are listed (they verify via rebuild, not
-        here); windows containing RECOVERED rows can't add evidence and
-        are flagged. Returns {"windows", "bad_windows": [offsets],
-        "missing_shards": [sids], "shard_size"}."""
+        Runs through the stripe-batch engine: `batch_windows` windows
+        per transform dispatch (ceil(W/B) dispatches per volume; the
+        tail block zero-pads past shard EOF, which verifies clean by
+        construction). Missing local shards are listed (they verify
+        via rebuild, not here); windows containing RECOVERED rows
+        can't add evidence and are flagged. Returns {"windows",
+        "bad_windows": [offsets], "missing_shards": [sids],
+        "shard_size", "batches", "dispatches", "preads"}."""
+        from .batch import (DEFAULT_BATCH_WINDOWS, clamp_batch_windows,
+                            window_blocks)
+        if batch_windows is None:
+            batch_windows = DEFAULT_BATCH_WINDOWS
+        batch_windows = clamp_batch_windows(batch_windows, window_size,
+                                            gf.TOTAL_SHARDS)
         ssize = self.shard_size
         missing = self.missing_shards()
         bad: list[int] = []
-        windows = 0
-        for off in range(0, ssize, window_size):
-            w = min(window_size, ssize - off)
-            windows += 1
-            if not self.verify_window(off, w):
-                bad.append(off)
-        return {"windows": windows, "bad_windows": bad,
+        n_windows = -(-ssize // window_size) if ssize else 0
+        stats: dict = {}
+        for wi, count in window_blocks(n_windows, batch_windows):
+            off = wi * window_size
+            for i, ok in enumerate(
+                    self.verify_window_block(off, count, window_size,
+                                             stats=stats)):
+                if not ok:
+                    bad.append(off + i * window_size)
+        return {"windows": n_windows, "bad_windows": bad,
                 "missing_shards": missing, "shard_size": ssize,
+                "batches": stats.get("batches", 0),
+                "dispatches": stats.get("dispatches", 0),
+                "preads": stats.get("preads", 0),
+                "remote_rows": stats.get("remote_rows", 0),
                 "used_recovered_rows": len(missing) > 0}
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:

@@ -84,6 +84,42 @@ def _compiled_transform(coeff_key: bytes, rows: int, k: int, use_pallas: bool):
     return fn
 
 
+@functools.lru_cache(maxsize=256)
+def _compiled_batch_transform(coeff_key: bytes, rows: int, k: int):
+    """jit of the VMAPPED bitplane transform for a fixed coefficient
+    matrix — compiled once per (rows, k) coefficient shape (jit's own
+    shape cache then holds one executable per (B, L) block shape).
+    The stripe-batch engine's device path: one dispatch carries a whole
+    (B, k, L) window block, and with the block sharded along the batch
+    dim XLA partitions the elementwise bitplane loops across devices
+    with zero cross-device traffic (the transform is per-window)."""
+    coeff = np.frombuffer(coeff_key, dtype=np.uint8).reshape(rows, k)
+    consts = gf.bitplane_constants(coeff)
+    return jax.jit(jax.vmap(lambda d: _apply_bitplanes(consts, d)))
+
+
+@functools.lru_cache(maxsize=8)
+def _batch_sharding(ndev: int):
+    """NamedSharding(P('batch')) over all attached devices (SNIPPETS.md
+    [1] pattern); None when a single device makes sharding moot."""
+    if ndev <= 1:
+        return None
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()), ("batch",))
+    return NamedSharding(mesh, P("batch"))
+
+
+def shard_along_batch(block):
+    """Place a (B, ...) block on the attached devices, sharded along
+    the leading (batch) dim when >1 device is attached and B divides
+    evenly; replicated single-device placement otherwise."""
+    sharding = _batch_sharding(jax.device_count())
+    if sharding is not None and block.shape[0] % jax.device_count() == 0:
+        return jax.device_put(block, sharding)
+    return jnp.asarray(block)
+
+
 def _default_use_pallas() -> bool:
     try:
         return jax.default_backend() == "tpu"
@@ -119,6 +155,55 @@ class JaxEncoder:
         self.use_pallas = use_pallas
         self.parity_coeff = gf.parity_matrix(self.k, self.n)
 
+    # -- batched API (stripe-batch engine, ec/batch.py) -------------------
+
+    def transform_batch(self, coeff: np.ndarray, block) -> jax.Array:
+        """Apply a (rows, k) coefficient matrix to a (B, k, L) window
+        block as ONE vmapped device dispatch -> (B, rows, L).
+
+        Dispatch is asynchronous (jax) — the caller reads back via
+        np.asarray when it actually needs the bytes, which is what lets
+        the engine overlap block N+1's preads with block N's kernel.
+        On the Pallas path the batch folds into the byte axis instead
+        (the transform is columnwise, and the explicit-DMA kernel
+        already tiles the stream); the vmapped XLA path is the one that
+        shards along the batch dim on a multi-device mesh."""
+        coeff = np.ascontiguousarray(coeff, dtype=np.uint8)
+        rows, k = coeff.shape
+        use_pallas = self.use_pallas
+        if use_pallas is None:
+            use_pallas = _default_use_pallas()
+        if use_pallas:
+            block = jnp.asarray(block, jnp.uint8)
+            bsz, k2, n = block.shape
+            flat = block.transpose(1, 0, 2).reshape(k2, bsz * n)
+            out = apply_transform(coeff, flat, True)
+            return out.reshape(rows, bsz, n).transpose(1, 0, 2)
+        fn = _compiled_batch_transform(coeff.tobytes(), rows, k)
+        return fn(shard_along_batch(np.asarray(block, np.uint8)))
+
+    def encode_batch(self, block) -> jax.Array:
+        """(B, k, L) data windows -> (B, k+m, L) full shard windows."""
+        block = jnp.asarray(block, jnp.uint8)
+        parity = self.transform_batch(self.parity_coeff, block)
+        return jnp.concatenate([block, parity], axis=1)
+
+    def verify_batch(self, block) -> np.ndarray:
+        """(B, k+m, L) stored windows -> (B,) bool verdicts; the parity
+        recompute AND the comparison both run on device, one dispatch."""
+        block = jnp.asarray(block, jnp.uint8)
+        par = self.transform_batch(self.parity_coeff,
+                                   block[:, :self.k, :])
+        return np.asarray((par == block[:, self.k:, :]).all(axis=(1, 2)))
+
+    def reconstruct_batch(self, present_rows: list[int],
+                          want_rows: list[int], block) -> jax.Array:
+        """Rebuild want_rows for every window of a (B, k, L) block of
+        present shards (stacked in present_rows order) -> (B, r, L)."""
+        coeff = gf.cached_shard_rows(tuple(want_rows),
+                                     tuple(present_rows), self.k, self.n)
+        return self.transform_batch(coeff, block)
+
     # data: (..., k, n) -> parity (..., m, n)
     def parity(self, data: jax.Array) -> jax.Array:
         return apply_transform(self.parity_coeff, data, self.use_pallas)
@@ -128,8 +213,11 @@ class JaxEncoder:
         data = jnp.asarray(data, jnp.uint8)
         return jnp.concatenate([data, self.parity(data)], axis=-2)
 
-    def verify(self, shards: jax.Array) -> bool:
-        shards = jnp.asarray(shards, jnp.uint8)
+    def verify(self, shards) -> bool:
+        """The unified backend verify: accepts a list of k+m equal-length
+        rows OR a stacked (..., k+m, L) array — the same
+        `verify(block) -> bool` signature as CpuEncoder."""
+        shards = jnp.asarray(np.asarray(shards, np.uint8))
         par = self.parity(shards[..., :self.k, :])
         return bool(jnp.array_equal(par, shards[..., self.k:, :]))
 

@@ -7,9 +7,11 @@ Reference workflow (ec_encoder.go):
   ec_decoder.go WriteDatFile(:150) shards -> .dat (ec.decode)
 
 The reference streams 256KB x 10 buffers through an AVX2 encoder; here each
-row batch is a host->HBM transfer and one Pallas kernel launch, so the
-batch unit is much larger (default 8MB per shard) to amortise dispatch and
-keep the kernel DMA-bound.
+row batch is a host->HBM transfer and one kernel launch, so the dispatch
+unit is much larger: 1 MB windows gathered eight at a time by the
+stripe-batch engine (ec/batch.py) — 8 MB per shard per dispatch, the same
+DMA-bound payload as the pre-batching 8 MB buffer, at an in-flight block
+the resident-byte budget can hold.
 """
 
 from __future__ import annotations
@@ -23,6 +25,8 @@ import numpy as np
 from ..storage import types as t
 from ..storage.needle_map import walk_index_blob, write_sorted_index
 from . import gf
+from .batch import (DEFAULT_BATCH_WINDOWS, add_stat, clamp_batch_windows,
+                    transform_block_async, window_blocks)
 from .locate import LARGE_BLOCK_SIZE, SMALL_BLOCK_SIZE
 
 # read-ahead / dispatch-ahead depth of the threaded encode pipeline: 2 is
@@ -227,12 +231,32 @@ def _run_overlapped(read_batches, launch, write_result,
         raise errs[0]
 
 
-def write_ec_files(base_name: str, encoder=None,
-                   large_block: int = LARGE_BLOCK_SIZE,
-                   small_block: int = SMALL_BLOCK_SIZE,
-                   buffer_size: int = 8 * 1024 * 1024) -> None:
-    """Stripe <base>.dat into <base>.ec00 .. .ec13 (WriteEcFiles),
-    overlapping file I/O with the device transform."""
+def encode_volume(base_name: str, encoder=None,
+                  large_block: int = LARGE_BLOCK_SIZE,
+                  small_block: int = SMALL_BLOCK_SIZE,
+                  buffer_size: int = 1024 * 1024,
+                  batch_windows: int = DEFAULT_BATCH_WINDOWS,
+                  stats: dict | None = None) -> None:
+    """Stripe <base>.dat into <base>.ec00 .. .ec13 (WriteEcFiles) with
+    the stripe-batch engine: up to `batch_windows` stripe windows
+    gather into one (B, 10, buf) block and ONE transform dispatch
+    emits all four parity rows for every window in the block —
+    ceil(W/B) dispatches per uniform-window run instead of W
+    (ec/batch.py). A volume has at most two such runs: groups flush
+    once at the large->small block-size boundary (mixed window
+    lengths never share a block), so the whole-volume count is
+    bounded by ceil(W_large/B) + ceil(W_small/B) — the exact
+    ceil(W/B) the bench gates on holds when the buffer size divides
+    both areas into equal windows (its geometry).
+
+    Windows whose preads are contiguous in the .dat (consecutive
+    buffers of the same block row) coalesce into one read per shard
+    position, so the batch cuts syscalls the same ratio it cuts
+    dispatches. File I/O still overlaps the device transform via the
+    double-buffered reader/writer threads (`_run_overlapped`);
+    `stats` accumulates the deterministic accounting
+    (windows/batches/dispatches/preads/bytes) tools/bench_ec.py
+    gates on."""
     encoder = encoder or get_encoder()
     parity = gf.parity_matrix()
     dat_path = base_name + ".dat"
@@ -240,34 +264,75 @@ def write_ec_files(base_name: str, encoder=None,
     outs = [open(base_name + to_ext(i), "wb") for i in range(gf.TOTAL_SHARDS)]
     f = open(dat_path, "rb")
 
-    def batches():
-        for start, block_size, buf, b in _iter_row_batches(
-                dat_size, large_block, small_block, buffer_size):
-            buffers = []
-            for i in range(gf.DATA_SHARDS):
-                f.seek(start + block_size * i + b * buf)
-                raw = f.read(buf)
-                if len(raw) < buf:
-                    raw = raw + b"\x00" * (buf - len(raw))
-                buffers.append(np.frombuffer(raw, np.uint8))
-            yield buffers
+    def groups():
+        pending: list[tuple] = []
+        limit = 1
+        for spec in _iter_row_batches(dat_size, large_block, small_block,
+                                      buffer_size):
+            if pending and (spec[2] != pending[0][2]
+                            or len(pending) >= limit):
+                yield pending
+                pending = []
+            if not pending:
+                # resident budget: data rows + parity rows per window
+                limit = clamp_batch_windows(batch_windows, spec[2],
+                                            gf.TOTAL_SHARDS)
+            pending.append(spec)
+        if pending:
+            yield pending
 
-    def launch(buffers):
-        thunk = _transform_buffers_async(encoder, parity, buffers)
+    def read_block(group):
+        """One (B, 10, buf) block read straight into its final array;
+        contiguous window reads coalesce into single preads per shard
+        position (no second joined-bytes copy is kept alive)."""
+        buf = group[0][2]
+        block = np.empty((len(group), gf.DATA_SHARDS, buf), np.uint8)
+        preads = 0
+        for i in range(gf.DATA_SHARDS):
+            runs: list[list[int]] = []
+            for start, bs, _, b in group:
+                off = start + bs * i + b * buf
+                if runs and off == runs[-1][0] + runs[-1][1]:
+                    runs[-1][1] += buf
+                else:
+                    runs.append([off, buf])
+            w = 0
+            for off, ln in runs:
+                f.seek(off)
+                raw = f.read(ln)
+                if len(raw) < ln:
+                    raw += b"\x00" * (ln - len(raw))
+                n = ln // buf
+                block[w:w + n, i, :] = np.frombuffer(
+                    raw, np.uint8).reshape(n, buf)
+                w += n
+            preads += len(runs)
+        return block, preads
+
+    def batches():
+        for group in groups():
+            yield read_block(group)
+
+    def launch(item):
+        block, preads = item
+        add_stat(stats, preads=preads, bytes_read=int(block.nbytes))
+        thunk = transform_block_async(encoder, parity, block, stats)
         try:
             from ..stats import metrics
             if metrics.HAVE_PROMETHEUS:
-                metrics.EC_ENCODE_BYTES.inc(sum(len(b) for b in buffers))
+                metrics.EC_ENCODE_BYTES.inc(int(block.nbytes))
         except ImportError:
             pass
-        return buffers, thunk
+        return item, thunk
 
-    def write_result(buffers, thunk):
-        parities = thunk()
+    def write_result(item, thunk):
+        block, _ = item
+        parities = thunk()      # (B, m, buf)
         for i in range(gf.DATA_SHARDS):
-            outs[i].write(buffers[i].tobytes())
-        for p, buf in enumerate(parities):
-            outs[gf.DATA_SHARDS + p].write(np.asarray(buf, np.uint8).tobytes())
+            outs[i].write(np.ascontiguousarray(block[:, i, :]).tobytes())
+        for p in range(gf.PARITY_SHARDS):
+            outs[gf.DATA_SHARDS + p].write(
+                np.ascontiguousarray(parities[:, p, :]).tobytes())
 
     try:
         _run_overlapped(batches(), launch, write_result,
@@ -276,6 +341,19 @@ def write_ec_files(base_name: str, encoder=None,
         f.close()
         for o in outs:
             o.close()
+
+
+def write_ec_files(base_name: str, encoder=None,
+                   large_block: int = LARGE_BLOCK_SIZE,
+                   small_block: int = SMALL_BLOCK_SIZE,
+                   buffer_size: int = 1024 * 1024,
+                   batch_windows: int = DEFAULT_BATCH_WINDOWS,
+                   stats: dict | None = None) -> None:
+    """Historical name for `encode_volume` (WriteEcFiles) — same
+    batched engine, byte-identical shard files at any batch size."""
+    encode_volume(base_name, encoder=encoder, large_block=large_block,
+                  small_block=small_block, buffer_size=buffer_size,
+                  batch_windows=batch_windows, stats=stats)
 
 
 def write_ec_files_batched(base_names: list[str], encoder=None,
@@ -397,43 +475,53 @@ def present_shards(base_name: str) -> list[int]:
 
 def _rebuild_rows(base_name: str, encoder, targets: list[int],
                   use: list[int], buffer_size: int,
-                  stats: dict | None) -> None:
-    """Regenerate the `targets` shard files from the k `use` shards in
-    ONE coefficient-matrix multiply per window: every window reads the
-    k survivor rows once and one encoder launch emits ALL target rows
-    (len(targets) x k coefficients) — the batched-rebuild unit."""
+                  stats: dict | None,
+                  batch_windows: int = DEFAULT_BATCH_WINDOWS) -> None:
+    """Regenerate the `targets` shard files from the k `use` shards
+    through the stripe-batch engine: up to `batch_windows` buffer
+    windows gather into one (B, k, buf) block read with ONE pread per
+    survivor, and ONE encoder dispatch emits ALL target rows for every
+    window in the block (len(targets) x k coefficients) — ceil(W/B)
+    dispatches per rebuild instead of W."""
     coeff = gf.cached_shard_rows(tuple(targets), tuple(use))
     shard_size = os.path.getsize(base_name + to_ext(use[0]))
     ins = [open(base_name + to_ext(i), "rb") for i in use]
     outs = [open(base_name + to_ext(i), "wb") for i in targets]
+    n_windows = -(-shard_size // buffer_size) if shard_size else 0
+    # resident budget: survivor rows in + target rows out per window
+    batch_windows = clamp_batch_windows(batch_windows, buffer_size,
+                                        len(use) + len(targets))
 
     def batches():
-        pos = 0
-        while pos < shard_size:
-            take = min(buffer_size, shard_size - pos)
-            buffers = []
+        for wi, count in window_blocks(n_windows, batch_windows):
+            pos = wi * buffer_size
+            take = min(count * buffer_size, shard_size - pos)
+            rows = []
             for f in ins:
                 f.seek(pos)
                 raw = f.read(take)
-                if len(raw) < take:
-                    raw += b"\x00" * (take - len(raw))
-                buffers.append(np.frombuffer(raw, np.uint8))
-            yield buffers
-            pos += take
+                # zero-pad the tail to whole windows: GF of zero rows
+                # is zero, and the pad is sliced off before writing
+                if len(raw) < count * buffer_size:
+                    raw += b"\x00" * (count * buffer_size - len(raw))
+                rows.append(np.frombuffer(raw, np.uint8
+                                          ).reshape(count, buffer_size))
+            add_stat(stats, preads=len(ins), bytes_read=take * len(ins))
+            yield np.stack(rows, axis=1), take
 
-    def launch(buffers):
+    def launch(item):
+        block, take = item
         if stats is not None:
-            stats["bytes_read"] = stats.get("bytes_read", 0) + \
-                sum(len(b) for b in buffers)
             stats["launches"] = stats.get("launches", 0) + 1
-        return buffers, _transform_buffers_async(encoder, coeff, buffers)
+        return item, transform_block_async(encoder, coeff, block, stats)
 
-    def write_result(buffers, thunk):
-        for o, buf in zip(outs, thunk()):
-            out = np.asarray(buf, np.uint8).tobytes()
-            if stats is not None:
-                stats["bytes_rebuilt"] = \
-                    stats.get("bytes_rebuilt", 0) + len(out)
+    def write_result(item, thunk):
+        block, take = item
+        rebuilt = thunk()       # (B, targets, buf)
+        for j, o in enumerate(outs):
+            out = np.ascontiguousarray(rebuilt[:, j, :]
+                                       ).tobytes()[:take]
+            add_stat(stats, bytes_rebuilt=len(out))
             o.write(out)
 
     try:
@@ -447,22 +535,24 @@ def _rebuild_rows(base_name: str, encoder, targets: list[int],
 
 
 def rebuild_ec_files(base_name: str, encoder=None,
-                     buffer_size: int = 8 * 1024 * 1024,
+                     buffer_size: int = 1024 * 1024,
                      sequential: bool = False,
-                     stats: dict | None = None) -> list[int]:
+                     stats: dict | None = None,
+                     batch_windows: int = DEFAULT_BATCH_WINDOWS) -> list[int]:
     """Regenerate missing shard files from >=10 present ones
     (RebuildEcFiles -> rebuildEcFiles, ec_encoder.go:227-281).
     Returns the rebuilt shard ids.
 
-    Default is the batched whole-volume rebuild: all missing shards
-    of the volume come out of a single coefficient-matrix multiply
-    per window — the survivors are read ONCE and one encoder launch
-    per window emits every lost row. `sequential=True` keeps the
-    per-shard shape (one full pass of survivor reads + one launch
-    stream PER lost shard) as the baseline tools/bench_ec.py measures
-    the batching win against; `stats` (optional dict) accumulates
-    bytes_read / bytes_rebuilt / launches / seconds for that
-    repair-bandwidth accounting."""
+    Default is the stripe-batched whole-volume rebuild: ALL missing
+    shards of the volume come out of one coefficient-matrix dispatch
+    per `batch_windows`-window block — the survivors are read ONCE
+    (one pread per survivor per block) and every lost row rides the
+    same launch. `sequential=True` keeps the pre-batching per-shard
+    shape (one full pass of survivor reads + one launch per window
+    PER lost shard) as the baseline tools/bench_ec.py measures the
+    batching win against; `stats` (optional dict) accumulates
+    bytes_read / bytes_rebuilt / launches / dispatches / preads /
+    windows / seconds for that repair-bandwidth accounting."""
     import time as _time
 
     encoder = encoder or get_encoder()
@@ -479,10 +569,10 @@ def rebuild_ec_files(base_name: str, encoder=None,
     if sequential:
         for target in missing:
             _rebuild_rows(base_name, encoder, [target], use,
-                          buffer_size, stats)
+                          buffer_size, stats, batch_windows=1)
     else:
         _rebuild_rows(base_name, encoder, missing, use,
-                      buffer_size, stats)
+                      buffer_size, stats, batch_windows=batch_windows)
     if stats is not None:
         stats["seconds"] = stats.get("seconds", 0.0) + \
             (_time.perf_counter() - t0)

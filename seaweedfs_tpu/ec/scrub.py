@@ -4,9 +4,12 @@ The silent-corruption detector the reference lacks: its integrity
 checking stops at per-needle CRCs *on read* (needle/crc.go), so a
 flipped bit in a cold shard is discovered only when a degraded read
 finally needs that row — mid-recovery, when redundancy is already
-spent. This scrubber walks every mounted EC volume window-by-window
-through ``EcVolume.verify_window`` (the same GF(256) transform the
-encoder uses) and reports corrupt windows BEFORE they cost data.
+spent. This scrubber walks every mounted EC volume in stripe-batched
+window blocks through ``EcVolume.read_window_block`` + the batch
+engine's ``verify_block`` (the same GF(256) transform the encoder
+uses, ONE dispatch per ``-scrub.batch`` windows, block N+1's preads
+overlapping block N's verify) and reports corrupt windows BEFORE they
+cost data.
 
 Three disciplines keep it invisible to the foreground data plane:
 
@@ -38,6 +41,8 @@ import time
 
 from ..util import events, glog, tracing
 from . import gf
+from .batch import (DEFAULT_BATCH_WINDOWS, clamp_batch_windows,
+                    verify_block)
 
 # how long the scrubber sleeps while parked behind hot foreground
 # traffic before re-checking
@@ -158,24 +163,50 @@ class Scrubber:
     # goes to glog.error and the corruptions counter)
     MAX_REPORTS = 64
 
+    # the scrubber's own block budget, TIGHTER than ec/batch.py's
+    # memory ceiling: for a paced background scan the bound is the
+    # per-block I/O *burst* the foreground shares a disk with, so it
+    # stays at the historical single-window footprint (~56 MB)
+    BLOCK_BYTES = 64 << 20
+
     def __init__(self, store, mbps: float = 8.0,
                  interval_s: float = 300.0,
-                 window_bytes: int = 4 << 20,
+                 window_bytes: int = 1 << 20,
                  pause_ms: float = 50.0,
                  pause_window_s: float = 2.0,
-                 load: ForegroundLoad | None = None):
+                 load: ForegroundLoad | None = None,
+                 batch_windows: int | None = None):
         self.store = store
         self.mbps = mbps
         self.interval_s = interval_s
+        # 1 MB stripe windows (was 4 MB pre-batching): with windows
+        # batched per dispatch the smaller unit costs nothing extra and
+        # localizes rot 4x finer; the per-block I/O burst stays at the
+        # historical ~56 MB because of the block byte budget below
         self.window_bytes = window_bytes
         self.pause_ms = pause_ms
         self.pause_window_s = pause_window_s
+        # stripe-batch width (-scrub.batch): windows verified per GF
+        # transform dispatch; the token bucket pays and the foreground
+        # pause gate runs per BLOCK, so a bigger batch trades pacing
+        # granularity for dispatch amortisation (1 = pre-batching
+        # shape). Clamped so one (B, 14, window) block stays inside
+        # BLOCK_BYTES — a 4 MB-window scrub can never burst a 448 MB
+        # block of reads however the flag is set.
+        if batch_windows is None:
+            batch_windows = DEFAULT_BATCH_WINDOWS
+        self.batch_windows = clamp_batch_windows(
+            max(1, batch_windows), window_bytes, gf.TOTAL_SHARDS,
+            budget=self.BLOCK_BYTES)
         self.bucket = TokenBucket(mbps * (1 << 20))
         self.load = load if load is not None else foreground
         self.state = "idle"
         self.current: dict | None = None
         self.cycles = 0
         self.windows = 0
+        self.batches = 0         # window blocks == GF transform
+        #                          dispatches (one per block; surfaced
+        #                          under both names in /debug/scrub)
         self.corrupt_windows = 0
         self.bytes_scanned = 0
         self.pauses = 0          # pause EVENTS (not poll iterations)
@@ -224,7 +255,8 @@ class Scrubber:
         double-scan (and double-charge the budget)."""
         async with self._cycle_lock:
             t0 = time.monotonic()
-            report = {"volumes": 0, "windows": 0, "corrupt": 0,
+            report = {"volumes": 0, "windows": 0, "batches": 0,
+                      "dispatches": 0, "corrupt": 0,
                       "bytes": 0, "skipped": [], "errors": []}
             for vid in sorted(self.store.ec_volumes):
                 ev = self.store.ec_volumes.get(vid)
@@ -272,60 +304,125 @@ class Scrubber:
                 {"volume": vid, "missing_shards": missing})
             return
         report["volumes"] += 1
+        n_windows = -(-ssize // self.window_bytes) if ssize else 0
         with tracing.start_root("scrub", "volume", vid=vid) as sp:
-            off = 0
-            while off < ssize:
-                w = min(self.window_bytes, ssize - off)
-                nbytes = w * gf.TOTAL_SHARDS
-                self.state = "scrubbing"
+            # stripe-batched block loop with ONE-block read-ahead: the
+            # preads of block N+1 (executor thread) overlap the GF
+            # verify dispatch of block N (another executor thread) —
+            # the scrub twin of the encode pipeline's double buffering.
+            # Pacing discipline is preserved per BLOCK: every block's
+            # bytes are paid for (token bucket) and the foreground
+            # pause gate consulted BEFORE its reads are issued.
+            wi = 0
+            vol_bytes = 0
+            self.current = None  # fresh volume: no stale position
+            nxt = await self._pay_and_read(vid, ev, ssize, n_windows, 0) \
+                if n_windows else None
+            read_err: BaseException | None = None
+            while nxt is not None and nxt != "unmounted":
+                off, count, nbytes, block = nxt
+                wi += count
+                # `current` tracks the block being VERIFIED: the
+                # read-ahead below must not advance the reported
+                # position past windows whose verdicts aren't in yet
+                # (a mid-cycle error would otherwise overstate
+                # coverage by one block)
                 self.current = {"volume": vid, "offset": off,
-                                "shard_size": ssize}
-                # pay for the window BEFORE reading it
-                self.paced_sleep_s += await self.bucket.consume(nbytes)
-                if self.load.hot(self.pause_ms, self.pause_window_s):
-                    # one pause EVENT (however long the park lasts);
-                    # paused_s carries the duration
-                    self.state = "paused"
-                    self.pauses += 1
-                    self._count("SCRUB_PAUSES")
-                    while self.load.hot(self.pause_ms,
-                                        self.pause_window_s):
-                        self.paused_s += _PAUSE_SLEEP_S
-                        await asyncio.sleep(_PAUSE_SLEEP_S)
-                self.state = "scrubbing"
-                if self.store.ec_volumes.get(vid) is not ev:
-                    sp.event("unmounted_midscrub")
-                    return  # unmounted/remounted under us: stop here
-                # strict: a row that would need RECONSTRUCTION mid-
-                # window (holder died since the cycle's missing-shards
-                # probe) raises instead of trivially verifying itself
-                # — the volume lands in the cycle's errors, never in
-                # its clean windows
-                ok = await tracing.run_in_executor(
-                    ev.verify_window, off, w, True)
-                self.windows += 1
+                                "shard_size": ssize, "windows": count}
+                # encoder resolved INSIDE the executor thunk: first-use
+                # lazy backend init (jax import, device probe) must
+                # never block the event loop mid-cycle
+                verify_task = asyncio.ensure_future(
+                    tracing.run_in_executor(
+                        lambda b=block, n=count * self.window_bytes:
+                        verify_block(ev.encoder(n), b)))
+                nxt, read_err = None, None
+                if wi < n_windows:
+                    try:
+                        # prefetch block N+1 while N verifies
+                        nxt = await self._pay_and_read(
+                            vid, ev, ssize, n_windows, wi)
+                    except asyncio.CancelledError:
+                        verify_task.cancel()
+                        raise
+                    except Exception as e:  # noqa: BLE001 — re-raised
+                        # below, AFTER block N's verdicts are counted
+                        read_err = e
+                oks = await verify_task
+                self.batches += 1
+                report["batches"] += 1
+                report["dispatches"] += 1
+                self._count("SCRUB_BATCHES")
+                self.windows += count
                 self.bytes_scanned += nbytes
-                report["windows"] += 1
+                vol_bytes += nbytes
+                report["windows"] += count
                 report["bytes"] += nbytes
                 self._count("SCRUB_BYTES", nbytes)
-                self._count("SCRUB_WINDOWS", 1,
-                            "clean" if ok else "corrupt")
-                if not ok:
+                for i, ok in enumerate(oks):
+                    woff = off + i * self.window_bytes
+                    w = min(self.window_bytes, ssize - woff)
+                    self._count("SCRUB_WINDOWS", 1,
+                                "clean" if ok else "corrupt")
+                    if ok:
+                        continue
                     self.corrupt_windows += 1
                     report["corrupt"] += 1
                     self._count("SCRUB_CORRUPTIONS")
-                    rec = {"volume": vid, "offset": off, "size": w,
+                    rec = {"volume": vid, "offset": woff, "size": w,
                            "wall": time.time()}
                     self.corruptions.append(rec)
-                    sp.event("corrupt_window", offset=off, size=w)
+                    sp.event("corrupt_window", offset=woff, size=w)
                     events.record("scrub_corruption", vid=vid,
-                                  offset=off, size=w)
+                                  offset=woff, size=w)
                     glog.error(
                         "scrub: CORRUPT ec window vid=%d off=%d "
                         "size=%d — stored parity disagrees with "
-                        "recomputed RS(10,4)", vid, off, w)
-                off += w
-            sp.nbytes = report["bytes"]
+                        "recomputed RS(10,4)", vid, woff, w)
+                if read_err is not None:
+                    raise read_err
+            if nxt == "unmounted":
+                sp.event("unmounted_midscrub")
+                return  # unmounted/remounted under us: stop here
+            # THIS volume's bytes, not the cycle-cumulative report sum
+            sp.nbytes = vol_bytes
+
+    async def _pay_and_read(self, vid: int, ev, ssize: int,
+                            n_windows: int, wi: int):
+        """Token-bucket pay + foreground-pause gate + read ONE window
+        block starting at window index `wi`. Returns (offset, count,
+        real_bytes, block), or "unmounted" when the volume moved under
+        us (checked after the gates, before any read I/O)."""
+        count = min(self.batch_windows, n_windows - wi)
+        off = wi * self.window_bytes
+        nbytes = (min(ssize - off, count * self.window_bytes)
+                  * gf.TOTAL_SHARDS)
+        self.state = "scrubbing"
+        if self.current is None:  # first block of a volume: nothing is
+            # verifying yet, so progress points at what is being read
+            self.current = {"volume": vid, "offset": off,
+                            "shard_size": ssize, "windows": count}
+        # pay for the block BEFORE reading it
+        self.paced_sleep_s += await self.bucket.consume(nbytes)
+        if self.load.hot(self.pause_ms, self.pause_window_s):
+            # one pause EVENT (however long the park lasts);
+            # paused_s carries the duration
+            self.state = "paused"
+            self.pauses += 1
+            self._count("SCRUB_PAUSES")
+            while self.load.hot(self.pause_ms, self.pause_window_s):
+                self.paused_s += _PAUSE_SLEEP_S
+                await asyncio.sleep(_PAUSE_SLEEP_S)
+            self.state = "scrubbing"
+        if self.store.ec_volumes.get(vid) is not ev:
+            return "unmounted"
+        # strict: a row that would need RECONSTRUCTION mid-cycle
+        # (holder died since the cycle's missing-shards probe) raises
+        # instead of trivially verifying itself — the volume lands in
+        # the cycle's errors, never in its clean windows
+        block = await tracing.run_in_executor(
+            ev.read_window_block, off, count, self.window_bytes, True)
+        return off, count, nbytes, block
 
     # ---- /debug/scrub ----
 
@@ -338,8 +435,11 @@ class Scrubber:
             "interval_s": self.interval_s,
             "window_bytes": self.window_bytes,
             "pause_ms": self.pause_ms,
+            "batch_windows": self.batch_windows,
             "cycles": self.cycles,
             "windows": self.windows,
+            "batches": self.batches,
+            "dispatches": self.batches,  # one GF dispatch per block
             "corrupt_windows": self.corrupt_windows,
             "bytes_scanned": self.bytes_scanned,
             "pauses": self.pauses,

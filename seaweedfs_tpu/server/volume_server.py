@@ -71,7 +71,8 @@ class VolumeServer:
                  sendfile_min: int = wire.SENDFILE_MIN,
                  scrub_mbps: float = 8.0,
                  scrub_interval: float = 0.0,
-                 scrub_pause_ms: float = 50.0):
+                 scrub_pause_ms: float = 50.0,
+                 scrub_batch: int | None = None):
         # -workers N process-per-core mode (server/workers.py): this
         # server is worker `ctx.index` of `ctx.total`, sharing the
         # public port via SO_REUSEPORT and owning vids % total == index
@@ -138,7 +139,8 @@ class VolumeServer:
         from ..ec.scrub import Scrubber
         self.scrubber = Scrubber(store, mbps=scrub_mbps,
                                  interval_s=scrub_interval,
-                                 pause_ms=scrub_pause_ms)
+                                 pause_ms=scrub_pause_ms,
+                                 batch_windows=scrub_batch)
         self.app = self._build_app()
         store.fetch_remote_shard = None  # wired after start (needs loop)
 
@@ -1914,13 +1916,18 @@ class VolumeServer:
             return web.json_response({"error": f"volume {vid} not found"},
                                      status=404)
 
+        stats: dict = {}
+
         def work():
-            ecpl.write_ec_files(base,
-                                large_block=self.store.ec_large_block,
-                                small_block=self.store.ec_small_block)
+            ecpl.encode_volume(base,
+                               large_block=self.store.ec_large_block,
+                               small_block=self.store.ec_small_block,
+                               stats=stats)
             ecpl.write_sorted_file_from_idx(base)
         await self._in_executor(work)
-        return web.json_response({"ok": True})
+        return web.json_response({"ok": True,
+                                  "windows": stats.get("windows", 0),
+                                  "dispatches": stats.get("dispatches", 0)})
 
     async def h_ec_generate_batch(self, req: web.Request) -> web.Response:
         """Batched VolumeEcShardsGenerate over several local volumes: one
@@ -2021,7 +2028,7 @@ class VolumeServer:
         if ev is None:
             return web.json_response({"error": f"ec volume {vid} not "
                                       f"mounted"}, status=404)
-        window = int(req.query.get("windowMB", 4)) << 20
+        window = int(req.query.get("windowMB", 1)) << 20
         try:
             report = await self._in_executor(lambda: ev.verify_parity(window))
         except (OSError, EcVolumeError) as e:

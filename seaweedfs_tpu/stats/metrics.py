@@ -98,6 +98,10 @@ if HAVE_PROMETHEUS:
         "SeaweedFS_scrub_cycles_total",
         "completed whole-store scrub cycles",
         registry=REGISTRY)
+    SCRUB_BATCHES = Counter(
+        "SeaweedFS_scrub_batches_total",
+        "stripe-window blocks scrubbed (one GF transform dispatch each)",
+        registry=REGISTRY)
     # binary frame wire (util/frame.py): the intra-host sibling hop's
     # request volume and its HTTP downgrades — a rising fallback rate
     # means the frame path is being severed (chaos or a peer that

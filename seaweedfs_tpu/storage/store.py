@@ -116,7 +116,11 @@ class Store:
                  partition: "tuple[int, int] | None" = None,
                  needle_cache_bytes: int = 0,
                  group_commit_window: float = 0.0,
-                 fsync: bool = False):
+                 fsync: bool = False,
+                 ec_small_recover_bytes: int | None = None):
+        # device-vs-host EC recover crossover (-ec.smallrecover flag);
+        # None keeps EcVolume.SMALL_RECOVER_BYTES
+        self.ec_small_recover_bytes = ec_small_recover_bytes
         # needle map kind for every owned volume (-index flag analog)
         self.index_type = index_type
         # hot-needle read cache (-cache.mem flag): parsed needles keyed
@@ -248,7 +252,8 @@ class Store:
                           vid),
                       recover_cache=self.ec_recover_cache,
                       holder_peek=self._make_holder_peek(vid),
-                      refresh_holders=self._make_holder_refresh(vid))
+                      refresh_holders=self._make_holder_refresh(vid),
+                      small_recover_bytes=self.ec_small_recover_bytes)
         self.ec_volumes[vid] = ev
         return ev
 

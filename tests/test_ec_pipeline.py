@@ -261,28 +261,30 @@ def test_overlapped_pipeline_error_propagates(tmp_path):
 
     calls = {"n": 0}
     from seaweedfs_tpu.ec import pipeline as plmod
-    orig = plmod._transform_buffers_async
+    from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
+    orig = plmod.transform_block_async
 
-    def exploding(encoder, coeff, buffers):
+    def exploding(encoder, coeff, block, stats=None):
         calls["n"] += 1
         if calls["n"] == 3:
             raise RuntimeError("kaboom")
         # stay off the device path under the fake: compute via numpy
-        return orig(object(), coeff, buffers)
+        return orig(CpuEncoder(use_native=False), coeff, block, stats)
 
-    plmod._transform_buffers_async = exploding
+    plmod.transform_block_async = exploding
     try:
         before = threading.active_count()
         with pytest.raises(RuntimeError, match="kaboom"):
             # JaxEncoder selects the THREADED pipeline (_use_overlap),
-            # which is the error path under test
+            # which is the error path under test; batch_windows=1
+            # keeps enough blocks in the stream for call 3 to land
             pl.write_ec_files(base, encoder=JaxEncoder(),
                               large_block=LB, small_block=SB,
-                              buffer_size=SB)
+                              buffer_size=SB, batch_windows=1)
         # pipeline threads joined, none leaked
         assert threading.active_count() <= before
     finally:
-        plmod._transform_buffers_async = orig
+        plmod.transform_block_async = orig
 
 
 def test_ec_backend_env_override(monkeypatch):

@@ -1,6 +1,6 @@
-"""Repair-bandwidth benchmark for the EC tier.
+"""Repair-bandwidth + stripe-batch benchmark for the EC tier.
 
-Measures the three costs ROADMAP item 3 targets, before/after style:
+Measures the costs ROADMAP items 2+3 target, before/after style:
 
 * **degraded reads** — drive N needle reads whose stripes touch lost
   shards through (a) the pre-PR *all-survivor gather* baseline (fixed
@@ -11,23 +11,36 @@ Measures the three costs ROADMAP item 3 targets, before/after style:
   STRICTLY fewer bytes (arxiv 2306.10528's selection win).
 * **whole-volume rebuild** — rebuild M lost shards sequentially (one
   full survivor pass per shard, the pre-batching shape) vs batched
-  (one coefficient-matrix multiply per window), byte-verifying both
-  against the originals; reports GB/s and the speedup.
+  (one coefficient-matrix dispatch per window block), byte-verifying
+  both against the originals; reports GB/s and the speedup.
+* **backend bake-off** (`--mode bakeoff`) — every available encoder
+  backend (cpu-numpy / cpu-native / jax) runs batched encode, verify
+  and reconstruct over the same (B, 10, L) window blocks: encode GB/s
+  (wall-clock, informational), dispatches-per-GB batched vs
+  per-window (deterministic), and a repair-bandwidth reconstruct row
+  — gated on byte-identity against the per-window numpy oracle. Also
+  measures the host-vs-device recover crossover that keeps the
+  `-ec.smallrecover` default honest.
+* **engine accounting** (`--mode engine`) — the stripe-batch engine's
+  deterministic dispatch/pread contract on a REAL volume:
+  `encode_volume`, `EcVolume.verify_parity` and `rebuild_ec_files`
+  at batch 1 vs batch B must be byte-identical with <= ceil(W/B)
+  transform dispatches and strictly fewer preads at B >= 8.
 
-Topology model: parity shards are local, lost shards are gone, the
-remaining data shards live on --holders emulated remote holders; every
-remote interval fetched is counted (bytes + per-holder round trips)
-by the same fetch hooks the volume server injects.
+Topology model (degraded mode): parity shards are local, lost shards
+are gone, the remaining data shards live on --holders emulated remote
+holders; every remote interval fetched is counted (bytes + per-holder
+round trips) by the same fetch hooks the volume server injects.
 
     python tools/bench_ec.py                    # full run (32 MB)
     python tools/bench_ec.py --smoke            # ci.sh gate (~4 MB):
-                                                # asserts plan < naive
-                                                # bytes, batched >=
-                                                # sequential, byte-
-                                                # identical rebuilds
+                                                # plan < naive bytes,
+                                                # batched dispatch/pread
+                                                # counts, byte-identity
+                                                # across backends
     python tools/bench_ec.py --json out.json
 
-Documented in PERF.md round 10 / EC.md.
+Documented in PERF.md rounds 10+13 / BENCH_EC.md / EC.md.
 """
 
 from __future__ import annotations
@@ -311,6 +324,11 @@ def bench_degraded(src: str, contents: dict, args, report: dict) -> None:
               "round trips, fetches <= k rows")
 
 
+REBUILD_BUF = 128 * 1024   # rebuild window: small enough that a bench
+#                            volume holds several per shard, so the
+#                            ceil(W/B) dispatch contract is exercised
+
+
 def bench_rebuild(src: str, args, report: dict) -> None:
     base = os.path.join(src, str(VID))
     lost = [0, 1, gf.DATA_SHARDS, gf.DATA_SHARDS + 1][:max(2, args.missing)]
@@ -318,6 +336,8 @@ def bench_rebuild(src: str, args, report: dict) -> None:
     for sid in lost:
         with open(base + pl.to_ext(sid), "rb") as f:
             originals[sid] = f.read()
+    shard_size = len(originals[lost[0]])
+    n_windows = -(-shard_size // REBUILD_BUF)
     results = {}
     for mode in ("sequential", "batched"):
         for sid in lost:
@@ -326,6 +346,8 @@ def bench_rebuild(src: str, args, report: dict) -> None:
         stats: dict = {}
         rebuilt = pl.rebuild_ec_files(base, encoder=pl.get_encoder("cpu"),
                                       sequential=(mode == "sequential"),
+                                      buffer_size=REBUILD_BUF,
+                                      batch_windows=args.batch,
                                       stats=stats)
         assert sorted(rebuilt) == sorted(lost), (rebuilt, lost)
         for sid in lost:
@@ -363,12 +385,259 @@ def bench_rebuild(src: str, args, report: dict) -> None:
             results["sequential"]["bytes_read"]
         assert results["batched"]["launches"] < \
             results["sequential"]["launches"]
+        # the stripe-batch engine's dispatch contract: ceil(W/B)
+        # transform dispatches for a W-window volume (vs W per lost
+        # shard in the sequential shape), at the engine's EFFECTIVE
+        # width (the byte budget may clamp a huge requested --batch)
+        from seaweedfs_tpu.ec.batch import clamp_batch_windows
+        eff = clamp_batch_windows(args.batch, REBUILD_BUF,
+                                  gf.DATA_SHARDS + len(lost))
+        want = -(-n_windows // eff)
+        assert results["batched"]["launches"] <= want, \
+            (results["batched"]["launches"], want, n_windows, eff)
         if speedup <= 1.0:
             print(f"  note: wall-clock speedup {speedup:.2f}x <= 1 at "
                   f"smoke size (noise); byte accounting still proves "
                   f"the batching win")
-        print("  smoke OK: batched reads survivors once, "
-              "byte-identical rebuilds")
+        print(f"  smoke OK: batched reads survivors once in "
+              f"{results['batched']['launches']} dispatches "
+              f"(<= ceil({n_windows}/{args.batch})), byte-identical "
+              f"rebuilds")
+
+
+def backends() -> list:
+    """Every encoder backend available in this container, numpy oracle
+    first (it is the byte-identity reference for the others)."""
+    from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
+    out = [("cpu-numpy", CpuEncoder(use_native=False))]
+    from seaweedfs_tpu.native import gf256 as _native
+    if _native.available():
+        out.append(("cpu-native", CpuEncoder(use_native=True)))
+    try:
+        from seaweedfs_tpu.ec.encoder_jax import JaxEncoder
+        out.append(("jax", JaxEncoder(use_pallas=False)))
+    except Exception as e:  # noqa: BLE001 — jax-less host: CPU rows only
+        print(f"  (jax backend unavailable: {type(e).__name__}: {e})")
+    return out
+
+
+def bench_bakeoff(args, report: dict) -> None:
+    """Backend bake-off over identical (B, 10, L) window blocks:
+    encode GB/s + dispatches-per-GB + repair reconstruct row per
+    backend, gated on byte-identity against the per-window numpy
+    oracle (wall-clock informational, accounting deterministic)."""
+    from seaweedfs_tpu.ec import batch as ecb
+    from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
+
+    B = args.batch
+    L = (64 if args.smoke else 512) * 1024      # window bytes
+    reps = 2 if args.smoke else 5
+    rng = np.random.default_rng(args.seed)
+    block = rng.integers(0, 256, (B, gf.DATA_SHARDS, L)).astype(np.uint8)
+    bytes_in = block.nbytes
+    # the oracle IS cpu-numpy by definition — no need to probe every
+    # backend (and double-initialize jax) just to fetch it
+    oracle = CpuEncoder(use_native=False)
+    rows_backends = backends()
+    # per-window numpy oracle: THE byte-identity gate
+    want_parity = np.stack([
+        np.stack(oracle.encode(list(block[b]))[gf.DATA_SHARDS:])
+        for b in range(B)])
+    full = np.concatenate([block, want_parity], axis=1)
+    present = [0, 2, 3, 4, 5, 6, 7, 8, 10, 12]
+    lost = [1, 9, 11, 13]
+    rows = {}
+    for name, enc in rows_backends:
+        # encode: batched (ONE dispatch per block) vs per-window
+        stats: dict = {}
+        par = ecb.transform_block(enc, gf.parity_matrix(), block, stats)
+        assert np.array_equal(par, want_parity), \
+            f"{name}: batched encode differs from numpy oracle"
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            np.asarray(enc.transform_batch(gf.parity_matrix(), block))
+        dt = (time.perf_counter() - t0) / reps
+        t0 = time.perf_counter()
+        for b in range(B):
+            np.asarray(enc.transform_batch(
+                gf.parity_matrix(), block[b:b + 1]))
+        dt_pw = time.perf_counter() - t0
+        # verify: per-window verdicts out of one dispatch
+        bad = full.copy()
+        bad[B // 2, gf.DATA_SHARDS + 1, 7] ^= 0x40
+        verdicts = ecb.verify_block(enc, bad)
+        assert verdicts == [i != B // 2 for i in range(B)], \
+            (name, verdicts)
+        assert ecb.verify_block(enc, full) == [True] * B, name
+        # reconstruct (repair-bandwidth row): all 4 lost rows of every
+        # window from one coefficient dispatch
+        t0 = time.perf_counter()
+        rec = np.asarray(enc.reconstruct_batch(present, lost,
+                                               full[:, present, :]))
+        dt_rec = time.perf_counter() - t0
+        assert np.array_equal(rec, full[:, lost, :]), \
+            f"{name}: batched reconstruct differs from numpy oracle"
+        rows[name] = {
+            "encode_GBps": round(bytes_in / (1 << 30) / dt, 3),
+            "encode_perwindow_GBps": round(
+                bytes_in / (1 << 30) / dt_pw, 3),
+            "dispatches_per_GB_batched": round((1 << 30) / bytes_in, 1),
+            "dispatches_per_GB_perwindow": round(
+                B * (1 << 30) / bytes_in, 1),
+            "repair_GBps": round(
+                len(lost) * B * L / (1 << 30) / dt_rec, 3),
+            "byte_identical": True,
+        }
+    report["bakeoff"] = {"batch": B, "window_bytes": L, "rows": rows}
+    print(f"backend bake-off (B={B} windows x 10 x {L >> 10}KB, "
+          f"{reps} reps; wall-clock informational, byte-identity "
+          f"gated):")
+    print(f"  {'backend':10s} {'enc GB/s':>9} {'1-win GB/s':>10} "
+          f"{'disp/GB B={}'.format(B):>12} {'disp/GB B=1':>12} "
+          f"{'repair GB/s':>12}")
+    for name, r in rows.items():
+        print(f"  {name:10s} {r['encode_GBps']:>9} "
+              f"{r['encode_perwindow_GBps']:>10} "
+              f"{r['dispatches_per_GB_batched']:>12} "
+              f"{r['dispatches_per_GB_perwindow']:>12} "
+              f"{r['repair_GBps']:>12}")
+    bench_crossover(args, report)
+    if args.smoke:
+        print("  smoke OK: every backend byte-identical to the "
+              "numpy oracle on encode/verify/reconstruct")
+
+
+def bench_crossover(args, report: dict) -> None:
+    """Measure the host-vs-device single-recover crossover that
+    `-ec.smallrecover` (default 1 MB) encodes: the smallest interval
+    at which dispatching the recover transform to the device backend
+    beats the host encoder. Informational — prints the measured value
+    next to the default so the flag stays honest."""
+    from seaweedfs_tpu.ec.encoder_cpu import CpuEncoder
+    try:
+        from seaweedfs_tpu.ec.encoder_jax import JaxEncoder
+        dev = JaxEncoder(use_pallas=False)
+    except Exception as e:  # noqa: BLE001 — no device backend: nothing
+        # to cross over to
+        print(f"  crossover: skipped (jax unavailable: {e})")
+        return
+    host = CpuEncoder()
+    coeff = gf.cached_shard_rows((0,), tuple(range(1, 11)))
+    sizes = [1 << s for s in range(16, 20 if args.smoke else 23)]
+    rng = np.random.default_rng(args.seed + 2)
+    rows = {}
+    crossover = None
+    for size in sizes:
+        blk = rng.integers(0, 256, (1, gf.DATA_SHARDS, size)
+                           ).astype(np.uint8)
+        times = {}
+        for name, enc in (("cpu", host), ("jax", dev)):
+            np.asarray(enc.transform_batch(coeff, blk))   # warm/compile
+            best = min(
+                _timed(lambda: np.asarray(enc.transform_batch(coeff, blk)))
+                for _ in range(3))
+            times[name] = best
+        rows[size] = {k: round(v * 1e3, 3) for k, v in times.items()}
+        if crossover is None and times["jax"] < times["cpu"]:
+            crossover = size
+    report["crossover"] = {"sizes_ms": rows,
+                           "measured_bytes": crossover,
+                           "default_bytes": 1 << 20}
+    got = f"{crossover} bytes" if crossover else \
+        f"none up to {sizes[-1]} bytes (host wins throughout)"
+    print(f"  -ec.smallrecover crossover: measured {got} "
+          f"(shipped default {1 << 20}); per-size ms {rows}")
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def bench_engine(src: str, args, report: dict) -> None:
+    """Deterministic stripe-batch accounting on a REAL volume: the
+    three bulk paths at batch 1 vs batch B must be byte-identical
+    with <= ceil(W/B) transform dispatches and strictly fewer
+    preads (rebuild is covered by bench_rebuild's asserts)."""
+    import hashlib
+
+    from seaweedfs_tpu.ec.batch import clamp_batch_windows
+
+    base = os.path.join(src, str(VID))
+    out: dict = {}
+    # --- encode_volume ---------------------------------------------------
+    enc_rows = {}
+    sums = {}
+    for bw in (1, args.batch):
+        stats: dict = {}
+        with tempfile.TemporaryDirectory(dir=src) as d:
+            nb = os.path.join(d, str(VID))
+            shutil.copy(base + ".dat", nb + ".dat")
+            pl.encode_volume(nb, encoder=pl.get_encoder("cpu"),
+                             large_block=LB, small_block=SB,
+                             buffer_size=SB, batch_windows=bw,
+                             stats=stats)
+            h = hashlib.sha256()
+            for sid in range(gf.TOTAL_SHARDS):
+                with open(nb + pl.to_ext(sid), "rb") as f:
+                    h.update(f.read())
+            sums[bw] = h.hexdigest()
+        enc_rows[bw] = stats
+    windows = enc_rows[1]["windows"]
+    out["encode"] = enc_rows
+    assert sums[1] == sums[args.batch], "batched encode not byte-identical"
+    # ceilings computed at the engine's EFFECTIVE width — the byte
+    # budget may clamp a huge requested --batch
+    eff = clamp_batch_windows(args.batch, SB, gf.TOTAL_SHARDS)
+    want = -(-windows // eff)
+    assert enc_rows[args.batch]["dispatches"] <= want, \
+        (enc_rows[args.batch]["dispatches"], want, eff)
+    assert enc_rows[args.batch]["preads"] < enc_rows[1]["preads"]
+    # --- verify_parity (the scrub transform) -----------------------------
+    window = 128 * 1024
+    ev = EcVolume(src, "", VID, large_block=LB, small_block=SB,
+                  encoder=pl.get_encoder("cpu"))
+    try:
+        # plant one flipped byte in a parity shard so the verdict set
+        # is non-trivial, then restore it
+        p12 = base + pl.to_ext(12)
+        with open(p12, "r+b") as f:
+            f.seek(window + 11)
+            orig = f.read(1)
+            f.seek(window + 11)
+            f.write(bytes([orig[0] ^ 0xFF]))
+        try:
+            reps = {bw: ev.verify_parity(window, batch_windows=bw)
+                    for bw in (1, args.batch)}
+        finally:
+            with open(p12, "r+b") as f:
+                f.seek(window + 11)
+                f.write(orig)
+    finally:
+        ev.close()
+    out["scrub"] = reps
+    r1, rb = reps[1], reps[args.batch]
+    assert r1["bad_windows"] == rb["bad_windows"] == [window], \
+        (r1["bad_windows"], rb["bad_windows"])
+    eff = clamp_batch_windows(args.batch, window, gf.TOTAL_SHARDS)
+    want = -(-r1["windows"] // eff)
+    assert rb["dispatches"] <= want, (rb["dispatches"], want, eff)
+    assert rb["preads"] < r1["preads"]
+    report["engine"] = out
+    print(f"stripe-batch engine accounting (B={args.batch}):")
+    print(f"  encode {windows} windows: dispatches "
+          f"{enc_rows[1]['dispatches']} -> "
+          f"{enc_rows[args.batch]['dispatches']} "
+          f"(<= ceil = {-(-windows // args.batch)}), preads "
+          f"{enc_rows[1]['preads']} -> {enc_rows[args.batch]['preads']}")
+    print(f"  scrub  {r1['windows']} windows: dispatches "
+          f"{r1['dispatches']} -> {rb['dispatches']}, preads "
+          f"{r1['preads']} -> {rb['preads']}, same corrupt verdicts "
+          f"{rb['bad_windows']}")
+    if args.smoke:
+        print("  smoke OK: batched encode+scrub byte-identical, "
+              "<= ceil(W/B) dispatches, strictly fewer preads")
 
 
 def main() -> int:
@@ -380,7 +649,11 @@ def main() -> int:
     ap.add_argument("--holders", type=int, default=3,
                     help="emulated remote holder count")
     ap.add_argument("--mode", default="all",
-                    choices=["all", "degraded", "rebuild"])
+                    choices=["all", "degraded", "rebuild", "bakeoff",
+                             "engine"])
+    ap.add_argument("--batch", type=int, default=8,
+                    help="stripe windows per transform dispatch "
+                         "(the engine's B)")
     ap.add_argument("--seed", type=int, default=1234)
     ap.add_argument("--json", default="")
     ap.add_argument("--smoke", action="store_true",
@@ -392,14 +665,26 @@ def main() -> int:
         args.size_mb = min(args.size_mb, 4.0)
         args.reads = min(args.reads, 60)
     rng = random.Random(args.seed)
-    report: dict = {"size_mb": args.size_mb, "missing": args.missing}
+    report: dict = {"size_mb": args.size_mb, "missing": args.missing,
+                    "batch": args.batch}
+    if args.mode == "bakeoff":
+        bench_bakeoff(args, report)
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(report, f, indent=2)
+            print(f"report written to {args.json}")
+        return 0
     with tempfile.TemporaryDirectory() as src:
         contents = build_volume(src, args.size_mb, rng)
         report["needles"] = len(contents)
         if args.mode in ("all", "degraded"):
             bench_degraded(src, contents, args, report)
+        if args.mode in ("all", "engine"):
+            bench_engine(src, args, report)
         if args.mode in ("all", "rebuild"):
             bench_rebuild(src, args, report)
+        if args.mode == "all":
+            bench_bakeoff(args, report)
     if args.json:
         with open(args.json, "w") as f:
             json.dump(report, f, indent=2)

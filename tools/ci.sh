@@ -77,11 +77,13 @@ if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/recorder_smoke.py; the
     exit 1
 fi
 
-echo "== ec repair-bandwidth smoke (minimal-fetch + batched rebuild) =="
-if ! timeout -k 10 180 env JAX_PLATFORMS=cpu python tools/bench_ec.py --smoke; then
-    echo "bench_ec smoke: FAILED (repair-bandwidth regression — minimal-"
-    echo "fetch must move strictly fewer bytes than the all-survivor"
-    echo "gather and batched rebuild must beat sequential; see above)"
+echo "== ec smoke (repair bandwidth + stripe-batch engine + bake-off) =="
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/bench_ec.py --smoke; then
+    echo "bench_ec smoke: FAILED (EC regression — minimal-fetch must"
+    echo "move strictly fewer bytes than the all-survivor gather, the"
+    echo "stripe-batch engine must stay byte-identical within"
+    echo "<= ceil(W/B) dispatches + fewer preads on encode/scrub/"
+    echo "rebuild, and every backend must match the numpy oracle)"
     exit 1
 fi
 
