@@ -123,6 +123,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "requests arrive from them)")
     m.add_argument("-volumePreallocate", action="store_true",
                    help="preallocate disk space for grown volumes")
+    m.add_argument("-autopilot.interval", dest="autopilot_interval",
+                   type=float, default=0.0,
+                   help="autopilot maintenance-plane cycle cadence "
+                        "seconds (leader-only observe->plan->execute: "
+                        "auto-rebuild lost/rotten EC shards, "
+                        "re-replicate, vacuum, cold-tier); 0 disables "
+                        "the loop (POST /debug/autopilot?run=1 still "
+                        "forces one cycle)")
+    m.add_argument("-autopilot.mbps", dest="autopilot_mbps",
+                   type=float, default=16.0,
+                   help="cluster-wide repair-bandwidth token bucket "
+                        "(MB/s of estimated repair bytes); <=0 "
+                        "unpaced")
+    m.add_argument("-autopilot.dryrun", dest="autopilot_dryrun",
+                   action="store_true",
+                   help="plan, journal and report the exact action "
+                        "ledger live mode would execute — but execute "
+                        "nothing")
+    m.add_argument("-autopilot.concurrency",
+                   dest="autopilot_concurrency", type=int, default=2,
+                   help="maintenance actions in flight at once")
+    m.add_argument("-autopilot.tier", dest="autopilot_tier",
+                   default="",
+                   help="tier backend id (e.g. s3.default) for "
+                        "tier_seal actions: sealed still-local "
+                        "volumes are shipped to it; empty disables "
+                        "cold-tiering actions")
 
     v = sub.add_parser("volume", help="start a volume server")
     _add_common(v)
@@ -668,6 +695,11 @@ async def _run_master(args) -> None:
             "admin_scripts_interval_s", 17 * 60.0),
         white_list=parse_white_list(args.whiteList),
         volume_preallocate=args.volumePreallocate,
+        autopilot_interval_s=args.autopilot_interval,
+        autopilot_mbps=args.autopilot_mbps,
+        autopilot_dryrun=args.autopilot_dryrun,
+        autopilot_concurrency=args.autopilot_concurrency,
+        autopilot_tier_backend=args.autopilot_tier,
         worker_ctx=worker_ctx))
     await m.start()
     push_task = None

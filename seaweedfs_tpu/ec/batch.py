@@ -112,6 +112,43 @@ def verify_block(encoder, block: np.ndarray,
     return [bool(ok) for ok in encoder.verify_batch(block)]
 
 
+def localize_corrupt_rows(encoder, rows: np.ndarray) -> "list[int]":
+    """Pin a corrupt stripe window's rot to ONE shard row, if possible.
+
+    `rows` is a (total_shards, L) uint8 window whose parity check
+    failed. For each hypothesis "shard c is the corrupt one", shard c
+    is reconstructed from k of the OTHER rows and the whole window is
+    re-verified with the reconstruction substituted: with a single
+    corrupt row only the true culprit's hypothesis makes the stripe
+    consistent (any other hypothesis leaves the corrupt row in the
+    equations, which then cannot all hold). Returns [culprit] when
+    exactly one hypothesis survives, [] when the window is ambiguous
+    (multi-shard rot) — the autopilot DEFERS unlocalized windows
+    rather than guessing which copy to destroy.
+
+    Cost: total_shards reconstruct+verify passes over ONE window, paid
+    only for corrupt windows — rot is rare by construction.
+    """
+    from . import gf
+
+    total = int(rows.shape[0])
+    k = gf.DATA_SHARDS
+    culprits: "list[int]" = []
+    for c in range(total):
+        sources = [s for s in range(total) if s != c][:k]
+        coeff = gf.cached_shard_rows((c,), tuple(sources))
+        from .pipeline import _transform_buffers
+        rec = _transform_buffers(encoder, coeff,
+                                 [np.ascontiguousarray(rows[s])
+                                  for s in sources])[0]
+        cand = np.array(rows, np.uint8, copy=True)
+        cand[c] = np.frombuffer(
+            np.asarray(rec, np.uint8).tobytes(), np.uint8)
+        if bool(encoder.verify_batch(cand[None, :, :])[0]):
+            culprits.append(c)
+    return culprits if len(culprits) == 1 else []
+
+
 def window_blocks(total_windows: int, batch_windows: int):
     """Yield (first_window_index, count) specs covering total_windows
     in ceil(total/batch) blocks — THE dispatch-count contract the

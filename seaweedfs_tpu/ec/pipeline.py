@@ -538,7 +538,9 @@ def rebuild_ec_files(base_name: str, encoder=None,
                      buffer_size: int = 1024 * 1024,
                      sequential: bool = False,
                      stats: dict | None = None,
-                     batch_windows: int = DEFAULT_BATCH_WINDOWS) -> list[int]:
+                     batch_windows: int = DEFAULT_BATCH_WINDOWS,
+                     targets: "list[int] | None" = None,
+                     use: "list[int] | None" = None) -> list[int]:
     """Regenerate missing shard files from >=10 present ones
     (RebuildEcFiles -> rebuildEcFiles, ec_encoder.go:227-281).
     Returns the rebuilt shard ids.
@@ -552,14 +554,36 @@ def rebuild_ec_files(base_name: str, encoder=None,
     PER lost shard) as the baseline tools/bench_ec.py measures the
     batching win against; `stats` (optional dict) accumulates
     bytes_read / bytes_rebuilt / launches / dispatches / preads /
-    windows / seconds for that repair-bandwidth accounting."""
+    windows / seconds for that repair-bandwidth accounting.
+
+    `targets` restricts WHICH absent shards are regenerated (the
+    rebuild-to-target admin route: a node rebuilding one shard it will
+    host must not also materialize every other missing shard only to
+    delete it again); None keeps the rebuild-everything default.
+    `use` restricts WHICH present shards feed the reconstruction (the
+    same route's validated clean-input set: the first-k-on-disk
+    default could otherwise pick up a local shard the caller knows to
+    be rotten); None keeps the first-k default."""
     import time as _time
 
     encoder = encoder or get_encoder()
     have = present_shards(base_name)
     missing = [i for i in range(gf.TOTAL_SHARDS) if i not in have]
+    if targets is not None:
+        absent = set(missing)
+        bad = [t for t in targets if t not in absent]
+        if bad:
+            raise ValueError(
+                f"rebuild targets {bad} already present on disk")
+        missing = sorted(set(targets))
     if not missing:
         return []
+    if use is not None:
+        absent_use = [s for s in use if s not in have]
+        if absent_use:
+            raise ValueError(
+                f"rebuild inputs {absent_use} not present on disk")
+        have = sorted(set(use))
     if len(have) < gf.DATA_SHARDS:
         raise ValueError(
             f"unrepairable: only {len(have)} shards present, "

@@ -42,7 +42,7 @@ import time
 from ..util import events, glog, tracing
 from . import gf
 from .batch import (DEFAULT_BATCH_WINDOWS, clamp_batch_windows,
-                    verify_block)
+                    localize_corrupt_rows, verify_block)
 
 # how long the scrubber sleeps while parked behind hot foreground
 # traffic before re-checking
@@ -219,6 +219,13 @@ class Scrubber:
         self.started_mono = time.monotonic()
         self.corruptions: collections.deque = collections.deque(
             maxlen=self.MAX_REPORTS)
+        # machine-readable corruption reports for the autopilot
+        # observer: (vid, window index/offset/size, LOCALIZED shard
+        # ids) — structure, not prose. The same rows ride each cycle
+        # report as `corrupt_windows` so a consumer can distinguish
+        # fresh evidence from the cumulative ring.
+        self.reported: collections.deque = collections.deque(
+            maxlen=self.MAX_REPORTS)
         self.last_cycle: dict | None = None
         self._cycle_lock = asyncio.Lock()
 
@@ -257,6 +264,7 @@ class Scrubber:
             t0 = time.monotonic()
             report = {"volumes": 0, "windows": 0, "batches": 0,
                       "dispatches": 0, "corrupt": 0,
+                      "corrupt_windows": [],
                       "bytes": 0, "skipped": [], "errors": []}
             for vid in sorted(self.store.ec_volumes):
                 ev = self.store.ec_volumes.get(vid)
@@ -369,16 +377,41 @@ class Scrubber:
                     self.corrupt_windows += 1
                     report["corrupt"] += 1
                     self._count("SCRUB_CORRUPTIONS")
+                    # localize the rot to one shard row (hypothesis
+                    # test over the block row slice we already hold):
+                    # the structured report the autopilot repairs
+                    # from. [] = ambiguous — the consumer must defer.
+                    try:
+                        # encoder resolved INSIDE the thunk, like the
+                        # verify dispatch: lazy backend init must not
+                        # block the event loop mid-cycle
+                        sids = await tracing.run_in_executor(
+                            lambda r=block[i]: localize_corrupt_rows(
+                                ev.encoder(self.window_bytes), r))
+                    except Exception as e:  # noqa: BLE001 —
+                        # localization is advisory evidence; its
+                        # failure must not hide the corruption itself
+                        glog.warning("scrub localize vid=%d off=%d: "
+                                     "%s", vid, woff, e)
+                        sids = []
                     rec = {"volume": vid, "offset": woff, "size": w,
                            "wall": time.time()}
                     self.corruptions.append(rec)
-                    sp.event("corrupt_window", offset=woff, size=w)
+                    struct = {"volume": vid,
+                              "window": woff // self.window_bytes,
+                              "offset": woff, "size": w,
+                              "shards": sids, "wall": rec["wall"]}
+                    self.reported.append(struct)
+                    report["corrupt_windows"].append(struct)
+                    sp.event("corrupt_window", offset=woff, size=w,
+                             shards=sids)
                     events.record("scrub_corruption", vid=vid,
-                                  offset=woff, size=w)
+                                  offset=woff, size=w, shards=sids)
                     glog.error(
                         "scrub: CORRUPT ec window vid=%d off=%d "
-                        "size=%d — stored parity disagrees with "
-                        "recomputed RS(10,4)", vid, woff, w)
+                        "size=%d shards=%s — stored parity disagrees "
+                        "with recomputed RS(10,4)", vid, woff, w,
+                        sids or "unlocalized")
                 if read_err is not None:
                     raise read_err
             if nxt == "unmounted":
@@ -448,5 +481,6 @@ class Scrubber:
             "started_wall": round(self.started_at, 3),
             "uptime_s": round(time.monotonic() - self.started_mono, 1),
             "corruptions": list(self.corruptions),
+            "reported_windows": list(self.reported),
             "last_cycle": self.last_cycle,
         }

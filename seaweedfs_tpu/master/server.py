@@ -46,6 +46,11 @@ class MasterServer:
                  admin_scripts_interval_s: float = 17 * 60.0,
                  white_list: list[str] | None = None,
                  volume_preallocate: bool = False,
+                 autopilot_interval_s: float = 0.0,
+                 autopilot_mbps: float = 16.0,
+                 autopilot_dryrun: bool = False,
+                 autopilot_concurrency: int = 2,
+                 autopilot_tier_backend: str = "",
                  worker_ctx=None):
         # -workers N (server/workers.py): this master is the PRIMARY
         # (worker 0) of a fleet whose other members are assign
@@ -109,6 +114,17 @@ class MasterServer:
         self._tasks: list[asyncio.Task] = []
         self._http: aiohttp.ClientSession | None = None
         self._grow_lock = asyncio.Lock()
+        # autopilot maintenance plane (autopilot/): the object always
+        # exists so POST /debug/autopilot?run=1 can force a cycle even
+        # with the loop disabled; the loop itself is leader-only and
+        # starts in start() when -autopilot.interval > 0
+        from ..autopilot import Autopilot
+        self.autopilot = Autopilot(
+            self, interval_s=autopilot_interval_s,
+            mbps=autopilot_mbps, dryrun=autopilot_dryrun,
+            concurrency=autopilot_concurrency,
+            tier_backend=autopilot_tier_backend,
+            garbage_threshold=garbage_threshold)
         self.app = self._build_app()
 
     # ------------------------------------------------------------------
@@ -184,6 +200,7 @@ class MasterServer:
         app.router.add_post("/debug/timeline", h_tl)
         app.router.add_get("/debug/events", h_ev)
         app.router.add_get("/debug/health", h_hl)
+        app.router.add_route("*", "/debug/autopilot", self.h_autopilot)
         app.router.add_route("*", "/vol/grow", self.h_grow)
         app.router.add_route("*", "/vol/vacuum", self.h_vacuum)
         app.router.add_route("*", "/col/delete", self.h_collection_delete)
@@ -247,6 +264,10 @@ class MasterServer:
             await self._make_election()
         await self.election.start()
         self._tasks.append(asyncio.create_task(self._liveness_loop()))
+        if self.autopilot.interval_s > 0:
+            # long-lived leader-only maintenance loop; handle retained
+            # and cancelled in stop() (orphan-task discipline)
+            self._tasks.append(asyncio.create_task(self.autopilot.run()))
         if self.maintenance_interval_s > 0:
             self._tasks.append(
                 asyncio.create_task(self._auto_vacuum_loop()))
@@ -910,6 +931,29 @@ class MasterServer:
         return resp
 
     # ---- automatic maintenance (leader-only) ----
+
+    async def h_autopilot(self, req: web.Request) -> web.Response:
+        """/debug/autopilot: maintenance-plane status (plan queue,
+        in-flight actions, per-cycle ledgers incl. dry-run). POST
+        ?run=1 forces one observe -> plan -> execute cycle NOW and
+        returns its report — how tests and the heal soak drive
+        deterministic convergence. Leader-only for POST: a follower
+        has no topology to observe."""
+        if req.method == "POST":
+            if req.query.get("run", "") not in ("1", "true"):
+                return web.json_response(
+                    {"error": "POST wants ?run=1"}, status=400)
+            if not self.is_leader:
+                return web.json_response(
+                    {"error": "not leader",
+                     "leader": self.leader_url or ""}, status=503)
+            report = await self.autopilot.run_cycle()
+            return web.json_response({
+                "cycle": report, "status": self.autopilot.status()})
+        if req.method != "GET":
+            return web.json_response({"error": "method not allowed"},
+                                     status=405)
+        return web.json_response({"autopilot": self.autopilot.status()})
 
     async def _auto_vacuum_loop(self) -> None:
         """Vacuum volumes whose garbage ratio exceeds the threshold, with

@@ -25,6 +25,9 @@ class VolumeInformationMessage:
     version: int = 3
     ttl: int = 0
     compact_revision: int = 0
+    # .dat lives on a tier backend (volume_tier.py): the autopilot
+    # must never re-plan tier_seal for an already-remote volume
+    remote: bool = False
 
     def to_dict(self) -> dict:
         return asdict(self)

@@ -133,6 +133,11 @@ class VolumeServer:
         self._no_frame: dict[str, float] = {}
         self._frame_uds = ""
         self._frame_server = None
+        # per-vid serialization for /admin/ec/rebuild_shard: an
+        # executor retry racing a still-running rebuild of the same
+        # volume must queue behind it, never interleave fetches and
+        # output writes into the same shard files
+        self._rebuild_locks: dict[int, asyncio.Lock] = {}
         # paced background parity scrubber (-scrub.interval > 0 starts
         # the loop; the object always exists so POST /debug/scrub?run=1
         # can force a cycle even when the loop is off)
@@ -317,6 +322,8 @@ class VolumeServer:
         app.router.add_post("/admin/ec/generate_batch",
                             self.h_ec_generate_batch)
         app.router.add_post("/admin/ec/rebuild", self.h_ec_rebuild)
+        app.router.add_post("/admin/ec/rebuild_shard",
+                            self.h_ec_rebuild_shard)
         app.router.add_post("/admin/ec/verify", self.h_ec_verify)
         app.router.add_post("/admin/ec/mount", self.h_ec_mount)
         app.router.add_post("/admin/ec/unmount", self.h_ec_unmount)
@@ -606,11 +613,16 @@ class VolumeServer:
                 glog.warning("remote ec shard %d.%d from %s: %s",
                              vid, shard_id, target, e)
                 continue
-        if attempted:
-            # a listed holder failed to serve: the map moved under us,
-            # make the next read re-resolve. A shard with NO listed
-            # holders is a correct map (lost shard) — don't invalidate,
-            # the caller reconstructs instead.
+        if attempted or not shards.get(str(shard_id)):
+            # a listed holder failed to serve — or the map lists NO
+            # holder for this shard: either way the topology may have
+            # moved under us (the autopilot re-hosts lost shards, and
+            # a map cached during the outage window would otherwise
+            # hide the repaired shard for the full TTL), so make the
+            # next read re-resolve. invalidate() is rate-bounded to
+            # one forced lookup per FRESH_S per vid, so a genuinely
+            # lost shard costs one master round trip per 11s, not one
+            # per probe; this read still reconstructs as before.
             self._ec_locations.invalidate(vid)
         return None
 
@@ -633,6 +645,11 @@ class VolumeServer:
                         (sid, off, size))
                     break
         if not by_holder:
+            # none of the wanted shards has a (non-self) listed holder:
+            # same stale-outage-map hazard as the single fetch above —
+            # schedule a rate-bounded re-resolve so a just-re-hosted
+            # shard becomes visible within FRESH_S, not TTL_S
+            self._ec_locations.invalidate(vid)
             return None
         trace_headers: dict = {}
         tracing.inject(trace_headers)
@@ -2016,6 +2033,209 @@ class VolumeServer:
         except ValueError as e:
             return web.json_response({"error": str(e)}, status=500)
         return web.json_response({"rebuilt": rebuilt})
+
+    async def _fetch_shard_file(self, source: str, vid: int,
+                                collection: str, ext: str,
+                                base: str) -> str | None:
+        """Pull one shard/index file from a holder via /admin/file
+        (the ec.copy fetch shape); returns an error string or None.
+        Streams into a temp file and renames only on a COMPLETE body:
+        a source dying mid-stream must never leave a truncated shard
+        file that a later pass would mount or feed into a rebuild."""
+        tmp = base + ext + ".fetch"
+        try:
+            await failpoints.fail("volume.ec_copy.fetch")
+            async with self._http.get(
+                    tls.url(source, "/admin/file"),
+                    params={"volume": str(vid),
+                            "collection": collection, "ext": ext},
+                    timeout=aiohttp.ClientTimeout(total=600)) as resp:
+                if resp.status != 200:
+                    return f"fetch {ext} from {source}: {resp.status}"
+                f = await self._in_executor(open, tmp, "wb")
+                try:
+                    async for chunk in resp.content.iter_chunked(1 << 20):
+                        await self._in_executor(f.write, chunk)
+                finally:
+                    await self._in_executor(f.close)
+            await self._in_executor(os.replace, tmp, base + ext)
+            return None
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            await self._in_executor(self._unlink_quiet, tmp)
+            return str(e)
+
+    async def h_ec_rebuild_shard(self, req: web.Request) -> web.Response:
+        """Rebuild-to-target shard placement — the autopilot's repair
+        primitive (no reference RPC; command_ec_rebuild.go gathers on
+        the freest node via N shell round trips, this does the same
+        server-side in ONE call so the planner owns placement):
+
+        POST ?volume=&collection=&shards=2,5&sources=0:host:port,...
+
+        This node regenerates the requested shards HERE: k clean
+        inputs are gathered from the source holders (currently-MOUNTED
+        local survivors are free), a local (rotten) copy of a
+        requested shard is set aside only once the inputs are
+        confirmed, the requested rows come out of one stripe-batched
+        rebuild over exactly the validated inputs, borrowed inputs are
+        dropped, and the result is mounted + registered. Serialized
+        per vid: an executor retry racing a still-running rebuild of
+        the same volume queues instead of interleaving file writes."""
+        q = req.query
+        vid = int(q["volume"])
+        collection = q.get("collection", "")
+        if not self.store.owns(vid):
+            # wrong -workers partition: refuse BEFORE streaming GBs of
+            # inputs the final mount would reject anyway — the
+            # executor falls over to its next ranked target
+            return web.json_response(
+                {"error": f"volume {vid} not in this worker's "
+                          f"partition"}, status=409)
+        want = sorted({int(x) for x in q.get("shards", "").split(",")
+                       if x})
+        if not want:
+            return web.json_response({"error": "no shards requested"},
+                                     status=400)
+        sources: dict[int, str] = {}
+        for part in q.get("sources", "").split(","):
+            if not part:
+                continue
+            sid_s, _, url = part.partition(":")
+            try:
+                sources[int(sid_s)] = url
+            except ValueError:
+                return web.json_response(
+                    {"error": f"bad sources entry {part!r}"}, status=400)
+        if len(self._rebuild_locks) > 1024:  # id-space leak bound
+            self._rebuild_locks = {
+                v: lk for v, lk in self._rebuild_locks.items()
+                if lk.locked()}
+        lock = self._rebuild_locks.setdefault(vid, asyncio.Lock())
+        async with lock:
+            return await self._rebuild_shard_locked(
+                vid, collection, want, sources)
+
+    async def _rebuild_shard_locked(self, vid: int, collection: str,
+                                    want: list,
+                                    sources: dict) -> web.Response:
+        base = self._base_name(vid, collection)
+        if base is None:
+            base = os.path.join(
+                self.store.dirs[0],
+                f"{collection}_{vid}" if collection else str(vid))
+        # 1. the sorted needle index must exist locally before mount
+        if not os.path.exists(base + ".ecx"):
+            holders = sorted(set(sources.values()))
+            err = f"no source holders for .ecx of volume {vid}"
+            for holder in holders:
+                err = await self._fetch_shard_file(
+                    holder, vid, collection, ".ecx", base)
+                if err is None:
+                    # the delete journal may legitimately not exist
+                    await self._fetch_shard_file(
+                        holder, vid, collection, ".ecj", base)
+                    break
+            if err is not None:
+                return web.json_response({"error": err}, status=502)
+        # 2. gather until k distinct clean inputs are on local disk
+        # (planner-listed survivors only; currently-MOUNTED local
+        # shards are free — an unmounted leftover file could be a
+        # stale generation, so anything else streams fresh from its
+        # listed holder, overwriting the leftover via the tmp+rename).
+        # Gathering runs BEFORE any local copy of a requested shard is
+        # touched: a failed gather must leave a rotten-but-mostly-good
+        # shard serving, never convert one corrupt window into a lost
+        # shard
+        ev0 = self.store.ec_volumes.get(vid)
+        mounted_now = set(ev0.shards) if ev0 is not None else set()
+        fetched: list[int] = []
+        inputs: list[int] = []
+        for sid in sorted(sources):
+            if sid in want:
+                continue
+            if len(inputs) >= gf.DATA_SHARDS:
+                break
+            if sid in mounted_now \
+                    and os.path.exists(base + ecpl.to_ext(sid)):
+                inputs.append(sid)
+                continue
+            err = await self._fetch_shard_file(
+                sources[sid], vid, collection, ecpl.to_ext(sid), base)
+            if err is not None:
+                glog.warning("rebuild_shard vid=%d: input %d: %s",
+                             vid, sid, err)
+                continue
+            fetched.append(sid)
+            inputs.append(sid)
+
+        async def drop_fetched() -> None:
+            for sid in fetched:
+                p = base + ecpl.to_ext(sid)
+                if os.path.exists(p):
+                    await self._in_executor(os.remove, p)
+
+        if len(inputs) < gf.DATA_SHARDS:
+            await drop_fetched()
+            return web.json_response(
+                {"error": f"unrepairable here: only {len(inputs)} "
+                          f"inputs gathered, need {gf.DATA_SHARDS}"},
+                status=409)
+        # 3. k inputs confirmed on disk — NOW a requested shard hosted
+        # here (in-place rot repair) is unmounted and its file set
+        # ASIDE (atomic rename, not delete): if the rebuild itself
+        # fails, the rotten-but-mostly-good copy is restored and
+        # remounted rather than lost outright
+        ev = self.store.ec_volumes.get(vid)
+        mounted_want = [sid for sid in want
+                        if ev is not None and sid in ev.shards]
+        if mounted_want:
+            self.store.unmount_ec_shards(vid, mounted_want)
+        aside: list[int] = []
+        for sid in want:
+            p = base + ecpl.to_ext(sid)
+            if os.path.exists(p):
+                await self._in_executor(os.replace, p, p + ".rot")
+                aside.append(sid)
+        # 4. regenerate ONLY the requested rows from ONLY the
+        # validated clean inputs (one batched dispatch per window
+        # block), 5. return the borrowed inputs' disk space
+        try:
+            rebuilt = await self._in_executor(
+                lambda: ecpl.rebuild_ec_files(base, targets=want,
+                                              use=inputs))
+        except (ValueError, OSError) as e:
+            for sid in aside:       # restore + remount the old copies
+                p = base + ecpl.to_ext(sid)
+                await self._in_executor(os.replace, p + ".rot", p)
+            await drop_fetched()
+            if aside:
+                try:
+                    self.store.mount_ec_shards(collection, vid)
+                except VolumeError as e2:
+                    glog.warning("rebuild_shard vid=%d: restore "
+                                 "remount: %s", vid, e2)
+                await self._heartbeat_now()
+            return web.json_response({"error": str(e)}, status=500)
+        for sid in aside:
+            await self._in_executor(self._unlink_quiet,
+                                    base + ecpl.to_ext(sid) + ".rot")
+        await drop_fetched()
+        # 6. mount what this node now hosts and register it NOW — a
+        # degraded read anywhere in the cluster must find the repaired
+        # shard without waiting out a pulse
+        try:
+            shards = self.store.mount_ec_shards(collection, vid)
+        except VolumeError as e:
+            # nothing got mounted: don't leave just-rebuilt shard
+            # files orphaned on disk to be resurrected as a stale
+            # generation later
+            for sid in rebuilt:
+                await self._in_executor(self._unlink_quiet,
+                                        base + ecpl.to_ext(sid))
+            return web.json_response({"error": str(e)}, status=409)
+        await self._heartbeat_now()
+        return web.json_response({"rebuilt": rebuilt, "mounted": shards,
+                                  "fetched_inputs": fetched})
 
     async def h_ec_verify(self, req: web.Request) -> web.Response:
         """Parity scrub of a mounted EC volume (EcVolume.verify_parity):

@@ -102,6 +102,39 @@ if HAVE_PROMETHEUS:
         "SeaweedFS_scrub_batches_total",
         "stripe-window blocks scrubbed (one GF transform dispatch each)",
         registry=REGISTRY)
+    # autopilot maintenance plane (autopilot/): the leader's
+    # observe -> plan -> execute loop — cycles, per-kind action
+    # outcomes, why actions were deferred, the paced repair bytes the
+    # token bucket admitted, and whether repair is parked behind a
+    # paging fleet
+    AUTOPILOT_CYCLES = Counter(
+        "SeaweedFS_autopilot_cycles_total",
+        "completed observe->plan->execute maintenance cycles",
+        registry=REGISTRY)
+    AUTOPILOT_ACTIONS = Counter(
+        "SeaweedFS_autopilot_actions_total",
+        "maintenance actions by kind and outcome (ok/error/dryrun)",
+        ["kind", "result"], registry=REGISTRY)
+    AUTOPILOT_DEFERRALS = Counter(
+        "SeaweedFS_autopilot_deferrals_total",
+        "planned-but-not-executed actions, by deferral reason",
+        ["reason"], registry=REGISTRY)
+    AUTOPILOT_REPAIR_BYTES = Counter(
+        "SeaweedFS_autopilot_repair_bytes_total",
+        "estimated bytes admitted through the repair token bucket",
+        registry=REGISTRY)
+    AUTOPILOT_PAUSES = Counter(
+        "SeaweedFS_autopilot_pauses_total",
+        "times the executor parked because /debug/health paged",
+        registry=REGISTRY)
+    AUTOPILOT_QUEUE_DEPTH = Gauge(
+        "SeaweedFS_autopilot_queue_depth",
+        "actions waiting in the current cycle's plan queue",
+        registry=REGISTRY)
+    AUTOPILOT_PAUSED = Gauge(
+        "SeaweedFS_autopilot_paused",
+        "1 while repair is parked behind a paging fleet",
+        registry=REGISTRY)
     # binary frame wire (util/frame.py): the intra-host sibling hop's
     # request volume and its HTTP downgrades — a rising fallback rate
     # means the frame path is being severed (chaos or a peer that

@@ -21,6 +21,8 @@ import urllib.error
 import urllib.request
 from typing import Callable, Protocol
 
+from ..util import failpoints
+
 
 class BackendError(Exception):
     pass
@@ -58,6 +60,11 @@ class S3BackendStorageFile:
     def read_at(self, offset: int, size: int) -> bytes:
         if size <= 0:
             return b""
+        # chaos site: a degraded remote tier (erroring or slow ranged
+        # GETs) must surface as a bounded read error through the normal
+        # OSError paths — never a wedged executor thread (sync: volume
+        # reads run in executor threads)
+        failpoints.sync_fail("tier.read")
         req = urllib.request.Request(
             self._b._url(self._key),
             headers={"Range": f"bytes={offset}-{offset + size - 1}"})
@@ -184,6 +191,9 @@ class MmapBackendStorageFile:
             raise BackendError(f"mmap open {path}: {e}") from e
 
     def read_at(self, offset: int, size: int) -> bytes:
+        # same site as the S3 path: every tiered read is breakable,
+        # whichever backend serves it
+        failpoints.sync_fail("tier.read")
         if self._mm is None or offset >= self._size:
             return b""
         return self._mm[offset:offset + size]

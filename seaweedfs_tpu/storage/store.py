@@ -710,7 +710,8 @@ class Store:
             deleted_byte_count=st.deleted_bytes, read_only=v.read_only,
             replica_placement=v.super_block.replica_placement.to_byte(),
             version=v.version, ttl=v.ttl.to_uint32(),
-            compact_revision=v.super_block.compaction_revision)
+            compact_revision=v.super_block.compaction_revision,
+            remote=v.is_remote)
 
     # minutes an expired TTL volume lingers before its files are
     # destroyed (store.go MAX_TTL_VOLUME_REMOVAL_DELAY); actual delay is

@@ -52,6 +52,40 @@ class VolumeLayout:
         return random.choice(candidates)
 
 
+def rank_repair_targets(nodes, holder_urls) -> "list[str]":
+    """Deterministic rack-aware candidate ranking for placing a
+    repaired replica or rebuilt EC shard (the autopilot planner's
+    placement primitive — the pure, seedless sibling of
+    find_empty_slots' randomized growth placement).
+
+    `nodes` is any iterable of objects with ``url``, ``data_center``,
+    ``rack`` and ``free_slots`` attributes (autopilot/plan.NodeState);
+    `holder_urls` the urls already holding a copy/shard of the volume.
+    Candidates exclude current holders and full nodes, and are ordered:
+
+      1. racks holding the FEWEST existing copies first (a repair must
+         widen failure domains, not deepen one — the reference's
+         command_volume_fix_replication.go preference);
+      2. more free slots first (capacity-weighted like pick_weighted,
+         but deterministically);
+      3. url ascending (the total-order tiebreak that makes identical
+         snapshots produce identical plans).
+    """
+    by_url = {n.url: n for n in nodes}
+    rack_load: dict[tuple, int] = {}
+    for u in holder_urls:
+        n = by_url.get(u)
+        if n is not None:
+            key = (n.data_center, n.rack)
+            rack_load[key] = rack_load.get(key, 0) + 1
+    candidates = [n for n in by_url.values()
+                  if n.url not in holder_urls and n.free_slots > 0]
+    candidates.sort(key=lambda n: (
+        rack_load.get((n.data_center, n.rack), 0),
+        -n.free_slots, n.url))
+    return [n.url for n in candidates]
+
+
 def find_empty_slots(topo: Topology, rp: ReplicaPlacement,
                      preferred_dc: str | None = None
                      ) -> list[DataNode]:

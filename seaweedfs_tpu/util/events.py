@@ -53,6 +53,13 @@ TYPES = frozenset({
     "worker_respawn",           # supervisor respawned a dead worker
     "fsync_upgrade",            # deepest-yet group-commit batch shared
                                 # one durable fsync point
+    "autopilot_action",         # maintenance plane executed (or
+                                # dry-ran) a repair/vacuum/tier action,
+                                # with the planner's `reason`
+    "autopilot_defer",          # an action was planned but NOT run
+                                # (unrepairable, no target, cooldown,
+                                # queue-full, paused-too-long)
+    "autopilot_pause",          # repair parked: /debug/health paged
 })
 
 _MAX_FIELDS = 16                # per-event field cap (bounded memory)

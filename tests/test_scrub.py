@@ -154,6 +154,67 @@ def test_scrub_detects_on_disk_bit_rot_in_every_planted_window(ec_store):
     run(go())
 
 
+def test_reported_windows_schema_and_localization(ec_store):
+    """Satellite: /debug/scrub corruption reports carry a
+    machine-readable `reported_windows` list — (vid, window index,
+    offset, size, LOCALIZED shard ids) — so the autopilot observer
+    consumes structure instead of parsing prose. Rot planted in a
+    parity shard AND a data shard must both be pinned to the right
+    shard id by the hypothesis test."""
+    d, store = ec_store
+    ssize = store.ec_volumes[3].shard_size
+    # window 0: parity-shard rot; a DIFFERENT window: data-shard rot
+    planted = {(pl.to_ext(12), 17): 12,
+               (pl.to_ext(4), ssize - 9): 4}
+    assert (ssize - 9) // WINDOW != 0
+    for (ext, off), _sid in planted.items():
+        p = os.path.join(d, "3" + ext)
+        with open(p, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+    s = Scrubber(store, mbps=0.0, window_bytes=WINDOW, pause_ms=0.0)
+
+    async def go():
+        report = await s.run_cycle()
+        rows = report["corrupt_windows"]
+        assert len(rows) == 2, rows
+        # the same structured rows ride the cumulative status ring
+        assert s.status()["reported_windows"] == rows
+        for row in rows:
+            for key in ("volume", "window", "offset", "size",
+                        "shards", "wall"):
+                assert key in row, (key, row)
+            assert row["offset"] == row["window"] * WINDOW
+        by_window = {r["window"]: r["shards"] for r in rows}
+        assert by_window[0] == [12]                     # parity rot
+        assert by_window[(ssize - 9) // WINDOW] == [4]  # data rot
+    run(go())
+
+
+def test_multi_shard_rot_in_one_window_stays_unlocalized(ec_store):
+    """Two shards rotten in the SAME window: no single-corruption
+    hypothesis holds, so `shards` must be [] — the autopilot defers
+    instead of guessing which copy to destroy."""
+    d, store = ec_store
+    for ext in (pl.to_ext(12), pl.to_ext(4)):
+        p = os.path.join(d, "3" + ext)
+        with open(p, "r+b") as f:
+            f.seek(40)
+            b = f.read(1)
+            f.seek(40)
+            f.write(bytes([b[0] ^ 0xFF]))
+    s = Scrubber(store, mbps=0.0, window_bytes=WINDOW, pause_ms=0.0)
+
+    async def go():
+        report = await s.run_cycle()
+        rows = [r for r in report["corrupt_windows"]
+                if r["window"] == 0]
+        assert rows and rows[0]["shards"] == [], rows
+    run(go())
+
+
 def test_scrub_detects_failpoint_injected_flip(ec_store):
     """scrub.read armed with `flip` corrupts scrub-side reads only:
     the scrubber must flag the window; a foreground needle read sees

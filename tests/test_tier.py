@@ -8,7 +8,9 @@ package's own S3 gateway — dogfooding the gateway as the object tier.
 """
 
 import asyncio
+import concurrent.futures
 import os
+import time
 
 import pytest
 
@@ -20,6 +22,8 @@ from seaweedfs_tpu.storage import backend as bk
 from seaweedfs_tpu.storage import volume_tier
 from seaweedfs_tpu.storage.needle import Needle
 from seaweedfs_tpu.storage.volume import Volume, VolumeError
+from seaweedfs_tpu.util import failpoints
+from seaweedfs_tpu.util.batchframe import parse_all
 
 
 @pytest.fixture(autouse=True)
@@ -198,6 +202,168 @@ def test_remote_volume_scan_readahead(tmp_path):
                 await loop.run_in_executor(None, work)
             finally:
                 await s3.stop()
+    run(body())
+
+
+def test_tier_read_failpoint_surfaces_error_not_hang(tmp_path):
+    """Satellite: a degraded remote tier (tier.read armed with error /
+    latency) must surface as a bounded read error through the normal
+    OSError paths — never a wedged executor thread or a stale byte."""
+    bk.load_backends({"mmap": {"hot": {"dir": str(tmp_path / "ram")}}})
+    v = Volume(str(tmp_path / "vols"), "", 8)
+    v.write_needle(Needle(cookie=4, id=1, data=b"cold-bytes" * 50))
+    volume_tier.tier_upload(v, "mmap.hot")
+    assert v.read_needle(1).data == b"cold-bytes" * 50
+    try:
+        failpoints.arm("tier.read", "error:*")
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            v.read_needle(1)
+        assert time.monotonic() - t0 < 5.0, "degraded read hung"
+        # latency action delays but completes, bytes still correct
+        failpoints.arm("tier.read", "latency=50:2")
+        assert v.read_needle(1).data == b"cold-bytes" * 50
+    finally:
+        failpoints.reset()
+    assert v.read_needle(1).data == b"cold-bytes" * 50
+    v.close()
+
+
+def test_degraded_tier_read_keeps_server_responsive(tmp_path):
+    """Cluster-level: with the remote tier erroring, reads of the
+    tiered volume fail fast with an HTTP error while reads of a
+    healthy local volume on the same server keep succeeding."""
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            bk.load_backends({"mmap": {"hot": {
+                "dir": str(tmp_path / "ram")}}})
+            a = await c.assign()
+            st, _ = await c.put(a["fid"], a["url"], b"tiered-data")
+            assert st == 201
+            vid = a["fid"].split(",")[0]
+            # a second volume that stays local
+            b = await c.assign(collection="hot")
+            st, _ = await c.put(b["fid"], b["url"], b"local-data")
+            assert st == 201
+            async with c.http.post(
+                    f"http://{a['url']}/admin/tier/upload",
+                    params={"volume": vid,
+                            "backend": "mmap.hot"}) as resp:
+                assert resp.status == 200, await resp.text()
+            try:
+                failpoints.arm("tier.read", "error:*")
+                t0 = time.monotonic()
+                stc, _ = await c.get(a["fid"], a["publicUrl"])
+                assert stc >= 500, stc     # surfaced, not hung/stale
+                assert time.monotonic() - t0 < 10.0
+                stc, data = await c.get(b["fid"], b["publicUrl"])
+                assert stc == 200 and data == b"local-data"
+            finally:
+                failpoints.reset()
+            stc, data = await c.get(a["fid"], a["publicUrl"])
+            assert stc == 200 and data == b"tiered-data"
+    run(body())
+
+
+def test_tier_upload_racing_reads_offline(tmp_path):
+    """Satellite: tier_upload sealing a volume while reader threads
+    hammer read_needle must stay byte-identical before/during/after
+    the local->remote switch."""
+    bk.load_backends({"mmap": {"hot": {"dir": str(tmp_path / "ram")}}})
+    v = Volume(str(tmp_path / "vols"), "", 9)
+    want = {i: bytes([i % 251]) * (400 + i * 13) for i in range(1, 41)}
+    for i, data in want.items():
+        v.write_needle(Needle(cookie=2, id=i, data=data))
+    stop = False
+    mismatches = []
+    reads = [0]
+
+    def reader():
+        while not stop:
+            for i, data in want.items():
+                got = v.read_needle(i).data
+                reads[0] += 1
+                if got != data:
+                    mismatches.append(i)
+                    return
+
+    with concurrent.futures.ThreadPoolExecutor(4) as pool:
+        futs = [pool.submit(reader) for _ in range(3)]
+        time.sleep(0.05)
+        volume_tier.tier_upload(v, "mmap.hot")
+        time.sleep(0.15)            # readers cross the switch
+        stop = True
+        for f in futs:
+            f.result(timeout=30)
+    assert not mismatches, mismatches
+    assert reads[0] > len(want), "readers never overlapped the switch"
+    assert v.is_remote
+    for i, data in want.items():    # and after
+        assert v.read_needle(i).data == data
+    v.close()
+
+
+def test_tier_upload_racing_batch_reads_cluster(tmp_path):
+    """Satellite: concurrent single-GET and /batch requests in flight
+    while /admin/tier/upload seals the volume stay byte-identical."""
+    async def body():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            bk.load_backends({"mmap": {"hot": {
+                "dir": str(tmp_path / "ram")}}})
+            a = await c.assign()
+            vid = a["fid"].split(",")[0]
+            fids = [a["fid"]]
+            want = {a["fid"]: b"race-0" * 100}
+            st, _ = await c.put(a["fid"], a["url"], want[a["fid"]])
+            assert st == 201
+            for i in range(1, 12):
+                fid = f"{vid},{i + 1:02x}0badc0de"
+                data = f"race-{i}".encode() * 100
+                st, _ = await c.put(fid, a["url"], data)
+                assert st == 201
+                fids.append(fid)
+                want[fid] = data
+            stop = asyncio.Event()
+            bad = []
+
+            async def single_reader():
+                while not stop.is_set():
+                    for fid in fids:
+                        stc, got = await c.get(fid, a["url"])
+                        if stc != 200 or got != want[fid]:
+                            bad.append(("single", fid, stc))
+                            return
+
+            async def batch_reader():
+                url = f"http://{a['url']}/batch?fids=" + ",".join(fids)
+                while not stop.is_set():
+                    async with c.http.get(url) as resp:
+                        blob = await resp.read()
+                        if resp.status != 200:
+                            bad.append(("batch", resp.status))
+                            return
+                    for meta, body_ in parse_all(blob):
+                        if meta.get("status") != 200 or \
+                                body_ != want[meta["fid"]]:
+                            bad.append(("batch-row", meta))
+                            return
+
+            readers = [asyncio.create_task(single_reader()),
+                       asyncio.create_task(batch_reader())]
+            await asyncio.sleep(0.05)
+            async with c.http.post(
+                    f"http://{a['url']}/admin/tier/upload",
+                    params={"volume": vid,
+                            "backend": "mmap.hot"}) as resp:
+                assert resp.status == 200, await resp.text()
+            await asyncio.sleep(0.3)   # reads keep racing post-switch
+            stop.set()
+            await asyncio.gather(*readers)
+            assert not bad, bad
+            # and afterwards, straight through the remote tier
+            for fid in fids:
+                stc, got = await c.get(fid, a["url"])
+                assert stc == 200 and got == want[fid]
     run(body())
 
 

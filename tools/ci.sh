@@ -87,6 +87,16 @@ if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/bench_ec.py --smoke; t
     exit 1
 fi
 
+echo "== autopilot heal smoke (soak heal --quick: rot + holder kill -> converge) =="
+if ! timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/soak.py heal --quick; then
+    echo "heal smoke: FAILED (the autopilot did not converge the fleet"
+    echo "back to full redundancy — planted rot must be scrub-localized"
+    echo "and rebuilt, the killed holder's shards re-hosted, foreground"
+    echo "reads untouched, and the dry-run ledger must match executed"
+    echo "actions; see output above)"
+    exit 1
+fi
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \

@@ -11,7 +11,13 @@ three recorder surfaces:
   /debug/events    — merged journal rows with type/wall_ms/mono/trace,
                      at least one volume_mount from the write path;
   /debug/health    — ok with a configured -slo objective evaluated
-                     (fast/slow burn rows present).
+                     (fast/slow burn rows present);
+  /debug/scrub     — merged scrubber status with the machine-readable
+                     `reported_windows` list and a forced cycle's
+                     `corrupt_windows` rows (the autopilot observer's
+                     input schema);
+  /debug/autopilot — maintenance-plane status + a forced dry-run
+                     cycle's planned/deferred/executed ledger.
 
 Any key drift in these payloads fails CI before a soak or operator
 tooling trips over it.
@@ -72,7 +78,8 @@ def main() -> int:
 
     try:
         spawn("master", "-port", str(PORT), "-mdir",
-              os.path.join(tmp, "m"), "-pulseSeconds", "1")
+              os.path.join(tmp, "m"), "-pulseSeconds", "1",
+              "-autopilot.dryrun")
         time.sleep(1.5)
         spawn("volume", "-port", str(PORT + 1), "-dir",
               os.path.join(tmp, "v"), "-max", "10", "-master", master,
@@ -158,6 +165,43 @@ def main() -> int:
             check(key in obj["fast"], f"burn window missing {key!r}")
         print(f"  health: {h['status']} ({obj['spec']}, fast burn "
               f"{obj['fast']['burn']})")
+
+        # -- /debug/scrub (autopilot observer input schema) -------------
+        sc = get_json(vol, "/debug/scrub")
+        check("workers" in sc, "/debug/scrub not worker-merged")
+        st = next(iter(sc["workers"].values()))
+        for key in ("state", "cycles", "corruptions",
+                    "reported_windows", "last_cycle"):
+            check(key in st, f"scrub status missing {key!r}")
+        forced = get_json(vol, "/debug/scrub?run=1", method="POST")
+        cyc = next(iter(forced["workers"].values()))["cycle"]
+        for key in ("volumes", "windows", "corrupt", "corrupt_windows",
+                    "bytes", "skipped", "errors", "seconds"):
+            check(key in cyc, f"scrub cycle missing {key!r}")
+        print(f"  scrub: {len(sc['workers'])} workers merged, cycle "
+              f"keys OK")
+
+        # -- /debug/autopilot (forced dry-run cycle) --------------------
+        ap = get_json(master, "/debug/autopilot")["autopilot"]
+        for key in ("enabled", "leader", "dryrun", "state", "cycles",
+                    "budget_mbps", "actions_ok", "actions_failed",
+                    "bytes_paid", "paced_sleep_s", "in_flight",
+                    "history", "last_cycle"):
+            check(key in ap, f"/debug/autopilot missing {key!r}")
+        check(ap["dryrun"] is True, "autopilot -autopilot.dryrun lost")
+        forced = get_json(master, "/debug/autopilot?run=1",
+                          method="POST")
+        for key in ("wall_ms", "seconds", "dryrun", "observed",
+                    "planned", "deferred", "executed"):
+            check(key in forced["cycle"],
+                  f"autopilot cycle missing {key!r}")
+        obs = forced["cycle"]["observed"]
+        for key in ("nodes", "volumes", "ec_volumes", "corruptions",
+                    "paging", "errors"):
+            check(key in obs, f"autopilot observed missing {key!r}")
+        check(obs["nodes"] >= 1, "autopilot observed no nodes")
+        print(f"  autopilot: dry-run cycle over {obs['nodes']} nodes, "
+              f"{len(forced['cycle']['planned'])} planned")
         print("recorder smoke: OK")
         return 0
     finally:

@@ -19,7 +19,9 @@ guessing prose), so rules/drift.py can diff the two:
 - **routes**      — every `/debug/<name>` / `/__debug__/<name>`
   string constant (registration and dispatch comparisons are both the
   live surface; names are normalized to the tail segment so the
-  gateway twins don't double-count).
+  gateway twins don't double-count), plus the tiered-storage admin
+  surface `/admin/tier/<name>` (normalized to `tier/<name>`) — the
+  route family README's tier docs claim.
 
 Doc side, two strictnesses:
 
@@ -56,10 +58,12 @@ _METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram", "Summary"})
 _FAILPOINT_FNS = frozenset({"fail", "sync_fail", "corrupt", "take",
                             "pending"})
 _ROUTE_RE = re.compile(r"^/(?:debug|__debug__)/([a-z_]+)$")
+_TIER_ROUTE_RE = re.compile(r"^/admin/(tier/[a-z_]+)$")
 
 _FLAG_TOKEN_RE = re.compile(r"(?<![\w-])-([a-zA-Z][a-zA-Z0-9.]*)")
 _METRIC_TOKEN_RE = re.compile(r"SeaweedFS_[A-Za-z0-9_{},*]*")
 _ROUTE_TOKEN_RE = re.compile(r"/(?:debug|__debug__)/([a-z_]+)")
+_TIER_ROUTE_TOKEN_RE = re.compile(r"/admin/(tier/[a-z_]+)")
 _BACKTICK_RE = re.compile(r"`([^`]+)`")
 
 
@@ -135,6 +139,9 @@ def extract_code(table: SymbolTable) -> CodeArtifacts:
             if isinstance(node, ast.Constant) \
                     and isinstance(node.value, str):
                 m = _ROUTE_RE.match(node.value)
+                if m:
+                    _add(out.routes, m.group(1), mod.rel, node.lineno)
+                m = _TIER_ROUTE_RE.match(node.value)
                 if m:
                     _add(out.routes, m.group(1), mod.rel, node.lineno)
             if not isinstance(node, ast.Call):
@@ -254,6 +261,9 @@ def extract_docs(repo: str = REPO,
                     out.metric_mentions.append(name)
                     out.metric_claims.append(DocClaim(name, rel, i))
             for m in _ROUTE_TOKEN_RE.finditer(line):
+                out.route_mentions.add(m.group(1))
+                out.route_claims.append(DocClaim(m.group(1), rel, i))
+            for m in _TIER_ROUTE_TOKEN_RE.finditer(line):
                 out.route_mentions.add(m.group(1))
                 out.route_claims.append(DocClaim(m.group(1), rel, i))
             for span in _BACKTICK_RE.findall(line):

@@ -40,7 +40,11 @@ FAILPOINT_SCOPE = ("seaweedfs_tpu/server/", "seaweedfs_tpu/replication/",
                    # within chaos-site reach (ec.shard_read,
                    # ec.recover.read, scrub.read)
                    "seaweedfs_tpu/ec/ec_volume.py",
-                   "seaweedfs_tpu/ec/scrub.py")
+                   "seaweedfs_tpu/ec/scrub.py",
+                   # the autopilot maintenance plane: chaos.py must be
+                   # able to break the healer itself (observe probes,
+                   # executor dispatch)
+                   "seaweedfs_tpu/autopilot/")
 
 
 def _mentions_evidence(fn: ast.AST, spec: re.Pattern) -> bool:
