@@ -193,6 +193,30 @@ class Autopilot:
         for row in deferred[:self.MAX_DEFER_EVENTS]:
             events.record("autopilot_defer", **row)
 
+        # deposed between observe and execute: the snapshot this cycle
+        # planned from belongs to a leadership that no longer exists —
+        # the successor's autopilot owns the cluster from ITS fresh
+        # observation. Halt with nothing executed (the executor's own
+        # is_leader gate also halts a deposition that lands mid-queue).
+        if not self.master.is_leader:
+            self.state = "follower"
+            report = {
+                "wall_ms": round(time.time() * 1000.0, 3),
+                "seconds": round(time.monotonic() - t0, 3),
+                "dryrun": self.dryrun,
+                "halted": "lost leadership",
+                "observed": {"nodes": len(snap.nodes),
+                             "volumes": len(snap.volumes),
+                             "ec_volumes": len(snap.ec_volumes),
+                             "corruptions": len(snap.corruptions),
+                             "paging": snap.paging,
+                             "errors": errors},
+                "planned": ledger, "deferred": deferred, "executed": [],
+            }
+            self.last_cycle = report
+            self.history.append(report)
+            return report
+
         self.state = "executing"
         results = await self.executor.execute(runnable)
         # cooldowns expire relative to when execution FINISHED: a

@@ -100,7 +100,19 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("-jwtKey", default="")
     m.add_argument("-peers", default="",
                    help="comma-separated peer masters host:port "
-                        "(enables leader election)")
+                        "(enables the raft quorum: leader election, "
+                        "replicated fid/volume-id allocation, follower "
+                        "307-redirect-to-leader)")
+    m.add_argument("-raft.timeout", dest="raft_timeout",
+                   default="1.0,2.0",
+                   help="election timeout range seconds 'min,max' "
+                        "(randomized per follower; failover completes "
+                        "within ~2 timeouts of a leader death)")
+    m.add_argument("-raft.pulse", dest="raft_pulse", type=float,
+                   default=0.3,
+                   help="leader AppendEntries heartbeat cadence "
+                        "seconds (the lease window derives from the "
+                        "election timeout, not this)")
     m.add_argument("-metricsGateway", default="",
                    help="prometheus push-gateway host:port")
     m.add_argument("-sequencer", default=None,
@@ -119,8 +131,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="comma-separated IPs/CIDRs allowed to use the "
                         "API; empty = no limit (guard.go). Heartbeating "
                         "volume servers are auto-admitted; with -peers, "
-                        "include the peer master IPs (proxied follower "
-                        "requests arrive from them)")
+                        "follower control routes 307-redirect so the "
+                        "CLIENT IP is judged on the leader — only "
+                        "/submit still proxies from peer master IPs")
     m.add_argument("-volumePreallocate", action="store_true",
                    help="preallocate disk space for grown volumes")
     m.add_argument("-autopilot.interval", dest="autopilot_interval",
@@ -673,6 +686,12 @@ async def _run_master(args) -> None:
         _watch_parent()
         worker_ctx = _make_worker_ctx(args, "master")
     toml_cfg = await tracing.run_in_executor(_load_master_toml)
+    try:
+        lo, _, hi = args.raft_timeout.partition(",")
+        election_timeout = (float(lo), float(hi or lo))
+    except ValueError:
+        raise SystemExit(f"-raft.timeout {args.raft_timeout!r}: "
+                         f"want 'min,max' seconds") from None
     # ctor makedirs -mdir; keep daemon construction off the loop —
     # under -workers respawn this loop is already serving
     m = await tracing.run_in_executor(lambda: MasterServer(
@@ -682,6 +701,8 @@ async def _run_master(args) -> None:
         pulse_seconds=args.pulseSeconds, jwt_key=args.jwtKey,
         peers=[p.strip() for p in args.peers.split(",")
                if p.strip()],
+        election_timeout=election_timeout,
+        election_pulse=args.raft_pulse,
         # explicit CLI flag beats discovered config (None =
         # flag not given, so even an explicit `-sequencer
         # memory` overrides a master.toml sequencer)

@@ -34,7 +34,7 @@ import time
 
 import aiohttp
 
-from ..util import glog, tracing
+from ..util import events, failpoints, glog, tracing
 
 # compact the log once it outgrows this many entries (each entry is one
 # volume-id bump; the reference's raft snapshots on a size threshold too)
@@ -65,14 +65,26 @@ class Election:
         self.majority = (len(self.peers) + 1) // 2 + 1
         self.timeout_range = election_timeout
         self.pulse = pulse
+        # per-attempt RPC deadline, strictly shorter than the minimum
+        # election timeout: one hung peer socket must never stretch a
+        # vote fan-out (or a replication round) past the next timeout
+        # fire — the campaign would then collide with its own retry
+        # forever instead of re-randomizing
+        self.attempt_timeout = max(0.05, min(election_timeout[0] * 0.5,
+                                             pulse * 2))
         self.term = 0
         self.voted_for: str | None = None
-        # replicated log: absolute index = snap.last_index + 1 + pos
-        self.snap = {"last_index": 0, "last_term": 0, "value": 0}
+        # replicated log: absolute index = snap.last_index + 1 + pos.
+        # `value` is the applied MaxVolumeId watermark; `seq` the applied
+        # file-id reservation ceiling (ids below it are spoken for by
+        # some committed reservation window — sequence.RaftSequencer)
+        self.snap = {"last_index": 0, "last_term": 0, "value": 0,
+                     "seq": 0}
         self.entries: list[dict] = []
         self.commit = 0
         self.applied = 0
         self.applied_value = 0
+        self.applied_seq = 0
         # durable (term, votedFor, snapshot, log), written BEFORE any
         # vote/append takes effect: without it a restarted master forgets
         # it voted and can grant a second vote in the same term — a
@@ -91,8 +103,10 @@ class Election:
                 raise SystemExit(
                     f"election state {state_path} unreadable/corrupt: {e};"
                     f" repair or remove it explicitly") from e
+            self.snap.setdefault("seq", 0)   # pre-HA state files
             self.commit = self.applied = self.snap["last_index"]
             self.applied_value = self.snap["value"]
+            self.applied_seq = self.snap["seq"]
         self.role = self.LEADER if self.single else self.FOLLOWER
         self.leader: str | None = self.me if self.single else None
         self.last_pulse = time.monotonic()
@@ -104,12 +118,48 @@ class Election:
         # replicated value (MaxVolumeId) exchange hooks, set by MasterServer
         self.get_max_volume_id = lambda: 0
         self.adopt_max_volume_id = lambda v: None
+        # replicated fid-reservation hook (sequence.RaftSequencer):
+        # called at APPLY time for every committed seq_reserve window,
+        # in log order, with the entry's author and term so only the
+        # reserving leader claims the window it committed
+        self.adopt_seq_window = lambda start, end, by, term: None
         self._http: aiohttp.ClientSession | None = None
         self._task: asyncio.Task | None = None
         # deferred-durability machinery: sync mutators mark, async
         # call sites flush before the state is acted on
         self._dirty = False
         self._flush_lock = asyncio.Lock()
+        # one replicated command in flight at a time: two interleaved
+        # append_command drivers would race next_index bookkeeping
+        self._append_lock = asyncio.Lock()
+        # last leader identity this node journaled (change detection)
+        self._noted_leader: str | None = None
+        if self.single:
+            # leader by fiat: journal + gauges so a single-mode master
+            # is observable through the same surfaces as a quorum one
+            self._note_leader(self.me)
+        self._update_gauges()
+
+    # ---- observability (journal + gauges) ----
+
+    def _note_leader(self, leader: str | None) -> None:
+        """Journal a leadership change exactly once per transition —
+        every node records the change it OBSERVED (wall_ms deltas
+        across the fleet bound the failover window)."""
+        if leader == self._noted_leader or not leader:
+            return
+        self._noted_leader = leader
+        events.record("raft_leader_change", leader=leader,
+                      term=self.term, me=self.me,
+                      role=self.role, single=self.single)
+
+    def _update_gauges(self) -> None:
+        from ..stats import metrics
+        if not metrics.HAVE_PROMETHEUS:
+            return
+        metrics.RAFT_TERM.set(self.term)
+        metrics.RAFT_COMMIT_INDEX.set(self.commit)
+        metrics.RAFT_IS_LEADER.set(1 if self.is_leader else 0)
 
     @property
     def is_leader(self) -> bool:
@@ -181,12 +231,27 @@ class Election:
         while self.applied < self.commit:
             self.applied += 1
             pos = self.applied - self.snap["last_index"] - 1
-            cmd = self.entries[pos]["cmd"]
+            entry = self.entries[pos]
+            cmd = entry["cmd"]
             v = int(cmd.get("max_volume_id", 0))
             if v > self.applied_value:
                 self.applied_value = v
                 self.adopt_max_volume_id(v)
+            # fid reservation window: RELATIVE by construction — the
+            # window is [applied_seq, applied_seq + n) at APPLY time,
+            # so windows partition the id space in log order no matter
+            # how stale the reserving leader's view was when it
+            # appended (a new leader's first reservation always lands
+            # ABOVE every window a deposed predecessor committed)
+            n = int(cmd.get("seq_reserve", 0))
+            if n > 0:
+                start = self.applied_seq
+                self.applied_seq = start + n
+                self.adopt_seq_window(start, self.applied_seq,
+                                      cmd.get("by", ""),
+                                      int(entry.get("term", -1)))
         self._maybe_snapshot()
+        self._update_gauges()
 
     def _maybe_snapshot(self) -> None:
         """Log compaction (the reference's raft snapshot): fold applied
@@ -197,7 +262,8 @@ class Election:
         cut = self.applied - self.snap["last_index"]
         self.snap = {"last_index": self.applied,
                      "last_term": self._term_at(self.applied) or 0,
-                     "value": self.applied_value}
+                     "value": self.applied_value,
+                     "seq": self.applied_seq}
         self.entries = self.entries[cut:]
         self._mark_dirty()
         glog.info("%s: snapshot at index %d (value %d, %d entries kept)",
@@ -270,6 +336,7 @@ class Election:
             self.last_pulse = time.monotonic()
         if granted or bumped:
             self._mark_dirty()  # the handler flushes before replying
+        self._update_gauges()
         return {"term": self.term, "granted": granted}
 
     def on_append(self, term: int, leader: str, prev_index: int,
@@ -288,6 +355,7 @@ class Election:
         self.leader = leader
         if leader != self.me:
             self._step_down()
+        self._note_leader(leader)
         self.last_pulse = time.monotonic()
         # consistency check at prev (entries already folded into the
         # snapshot are by definition committed => consistent)
@@ -319,10 +387,12 @@ class Election:
         if leader_commit > self.commit:
             self.commit = min(leader_commit, self.last_index())
             self._apply_committed()
+        self._update_gauges()
         return {"term": self.term, "ok": True, "match": match}
 
     def on_install_snapshot(self, term: int, leader: str, last_index: int,
-                            last_term: int, value: int) -> dict:
+                            last_term: int, value: int,
+                            seq: int = 0) -> dict:
         """InstallSnapshot for followers whose log is behind the leader's
         compaction point."""
         if self.single or term < self.term:
@@ -336,16 +406,23 @@ class Election:
             self._mark_dirty()
         self.leader = leader
         self._step_down()
+        self._note_leader(leader)
         self.last_pulse = time.monotonic()
         if last_index > self.last_index():
             self.snap = {"last_index": last_index, "last_term": last_term,
-                         "value": value}
+                         "value": value, "seq": seq}
             self.entries = []
             self.commit = self.applied = last_index
             if value > self.applied_value:
                 self.applied_value = value
                 self.adopt_max_volume_id(value)
+            if seq > self.applied_seq:
+                # folded reservation windows: adopt as foreign (by=""),
+                # so the installing node fences its counter past them
+                self.applied_seq = seq
+                self.adopt_seq_window(0, seq, "", -1)
             self._mark_dirty()
+        self._update_gauges()
         return {"term": self.term, "ok": True}
 
     # back-compat alias: the round-4 pulse RPC carried the value inline
@@ -361,7 +438,13 @@ class Election:
         if self.role != self.FOLLOWER:
             glog.info("%s: stepping down from %s at term %d",
                       self.me, self.role, self.term)
+            if self.role == self.LEADER:
+                # journal only real depositions (candidate -> follower
+                # happens every lost election and would flood the ring)
+                events.record("raft_step_down", me=self.me,
+                              term=self.term)
             self.role = self.FOLLOWER
+            self._update_gauges()
 
     # ---- the election / heartbeat loop ----
 
@@ -396,14 +479,23 @@ class Election:
 
         async def ask(peer: str) -> bool:
             try:
-                async with self._http.post(
-                        tls.url(peer, "/raft/vote"),
-                        json={"term": term, "candidate": self.me,
-                              "last_log_index": self.last_index(),
-                              "last_log_term": self.last_log_term(),
-                              "max_volume_id": self.get_max_volume_id()},
-                ) as resp:
-                    body = await resp.json()
+                # chaos site: error/latency/drop model a dead, slow or
+                # partitioned peer on the vote fan-out. The wait_for is
+                # the per-ATTEMPT deadline: one hung peer socket (or an
+                # armed latency) must not stretch this campaign past
+                # the next election-timeout fire
+                async def one() -> dict:
+                    await failpoints.fail("master.vote")
+                    async with self._http.post(
+                            tls.url(peer, "/raft/vote"),
+                            json={"term": term, "candidate": self.me,
+                                  "last_log_index": self.last_index(),
+                                  "last_log_term": self.last_log_term(),
+                                  "max_volume_id":
+                                      self.get_max_volume_id()},
+                    ) as resp:
+                        return await resp.json()
+                body = await asyncio.wait_for(one(), self.attempt_timeout)
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
                 return False
             if body.get("term", 0) > self.term:
@@ -421,11 +513,13 @@ class Election:
                       self.me, term, votes, len(self.peers) + 1)
             self.role = self.LEADER
             self.leader = self.me
+            self._note_leader(self.me)
             self._last_quorum = time.monotonic()
             # raft leader init: replicate from the end, learn backwards
             self.next_index = {p: self.last_index() + 1
                                for p in self.peers}
             self.match_index = {p: 0 for p in self.peers}
+            self._update_gauges()
             await self._replicate_round()
         else:
             self._step_down()
@@ -433,6 +527,7 @@ class Election:
             # split candidates colliding in lockstep (the randomized
             # timeout only de-syncs them if both wait a fresh one)
             self.last_pulse = time.monotonic()
+            self._update_gauges()
 
     async def _replicate_round(self) -> int:
         """One AppendEntries round to every peer: heartbeat, log catch-up
@@ -443,14 +538,24 @@ class Election:
             ni = self.next_index.get(peer, self.last_index() + 1)
             try:
                 if ni <= self.snap["last_index"]:
-                    # peer is behind our compaction point
-                    async with self._http.post(
-                            tls.url(peer, "/raft/snapshot"),
-                            json={"term": self.term, "leader": self.me,
-                                  "last_index": self.snap["last_index"],
-                                  "last_term": self.snap["last_term"],
-                                  "value": self.snap["value"]}) as resp:
-                        reply = await resp.json()
+                    # peer is behind our compaction point. Chaos site:
+                    # a dropped/failed InstallSnapshot models a
+                    # partition mid-catch-up
+                    async def snap_rpc() -> dict:
+                        await failpoints.fail("master.snapshot")
+                        async with self._http.post(
+                                tls.url(peer, "/raft/snapshot"),
+                                json={"term": self.term,
+                                      "leader": self.me,
+                                      "last_index":
+                                          self.snap["last_index"],
+                                      "last_term":
+                                          self.snap["last_term"],
+                                      "value": self.snap["value"],
+                                      "seq": self.snap["seq"]}) as resp:
+                            return await resp.json()
+                    reply = await asyncio.wait_for(snap_rpc(),
+                                                   self.attempt_timeout)
                     if reply.get("term", 0) > self.term:
                         self._adopt_higher_term(reply["term"])
                         return False
@@ -462,18 +567,30 @@ class Election:
                 prev = ni - 1
                 pos = prev - self.snap["last_index"]
                 batch = self.entries[pos:]
-                async with self._http.post(
-                        tls.url(peer, "/raft/heartbeat"),
-                        json={"term": self.term, "leader": self.me,
-                              "prev_index": prev,
-                              "prev_term": self._term_at(prev) or 0,
-                              "entries": batch,
-                              "commit": self.commit,
-                              # legacy field so a mid-upgrade peer still
-                              # adopts the watermark
-                              "max_volume_id": self.get_max_volume_id()},
-                ) as resp:
-                    reply = await resp.json()
+
+                # chaos site: error/latency/drop on the AppendEntries
+                # pulse — `drop` on a leader partitions it outbound, so
+                # its lease expires while a successor gets elected (the
+                # exact window tools/chaos.py ha arms). Per-attempt
+                # deadline so one hung follower cannot stall the round
+                # past the lease/pulse cadence.
+                async def append_rpc() -> dict:
+                    await failpoints.fail("master.append")
+                    async with self._http.post(
+                            tls.url(peer, "/raft/heartbeat"),
+                            json={"term": self.term, "leader": self.me,
+                                  "prev_index": prev,
+                                  "prev_term": self._term_at(prev) or 0,
+                                  "entries": batch,
+                                  "commit": self.commit,
+                                  # legacy field so a mid-upgrade peer
+                                  # still adopts the watermark
+                                  "max_volume_id":
+                                      self.get_max_volume_id()},
+                    ) as resp:
+                        return await resp.json()
+                reply = await asyncio.wait_for(append_rpc(),
+                                               self.attempt_timeout)
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
                 return False
             if reply.get("term", 0) > self.term:
@@ -508,6 +625,7 @@ class Election:
         # snapshot compaction / adopted-higher-term dirt from this
         # round becomes durable before the next round acts on it
         await self.flush()
+        self._update_gauges()
         return acks
 
     def _adopt_higher_term(self, term: int) -> None:
@@ -515,6 +633,7 @@ class Election:
         self.voted_for = None
         self._mark_dirty()
         self._step_down()
+        self._update_gauges()
 
     # ---- client surface ----
 
@@ -528,23 +647,33 @@ class Election:
             v = int(cmd.get("max_volume_id", 0))
             if v > self.applied_value:
                 self.applied_value = v
+            n = int(cmd.get("seq_reserve", 0))
+            if n > 0:
+                start = self.applied_seq
+                self.applied_seq = start + n
+                self.adopt_seq_window(start, self.applied_seq,
+                                      cmd.get("by", ""), self.term)
             return True
-        if not self.is_leader:
-            return False
-        self.entries.append({"term": self.term, "cmd": cmd})
-        self._mark_dirty()
-        # the leader counts itself in the quorum, so its own log entry
-        # must be durable before any peer acks are tallied
-        await self.flush()
-        idx = self.last_index()
-        for _ in range(rounds):
-            await self._replicate_round()
-            if self.commit >= idx:
-                return True
+        # serialize command commits: two interleaved append_command
+        # drivers would race the per-peer next/match bookkeeping (and
+        # their replication rounds would double-send suffixes)
+        async with self._append_lock:
             if not self.is_leader:
                 return False
-            await asyncio.sleep(self.pulse / 4)
-        return self.commit >= idx
+            self.entries.append({"term": self.term, "cmd": cmd})
+            self._mark_dirty()
+            # the leader counts itself in the quorum, so its own log
+            # entry must be durable before any peer acks are tallied
+            await self.flush()
+            idx = self.last_index()
+            for _ in range(rounds):
+                await self._replicate_round()
+                if self.commit >= idx:
+                    return True
+                if not self.is_leader:
+                    return False
+                await asyncio.sleep(self.pulse / 4)
+            return self.commit >= idx
 
     async def commit_max_volume_id(self) -> bool:
         """Synchronously replicate the current MaxVolumeId watermark to a

@@ -1,11 +1,32 @@
 """Monotonic needle-key sequencer (reference: weed/sequence/sequence.go,
 memory_sequencer.go; the etcd-backed variant maps to a pluggable subclass).
+
+``RaftSequencer`` is the HA variant: under ``-peers`` the quorum log IS
+the durable shared allocator — the leader raft-commits relative
+reservation windows (``seq_reserve`` commands) and only ever hands out
+ids inside a window its own committed log owns, so a deposed leader and
+its successor can never issue the same file id (see the class docstring
+for the fencing argument).
 """
 
 from __future__ import annotations
 
+import asyncio
 import os
 import threading
+
+from ..util import failpoints
+
+
+class SequenceBehind(Exception):
+    """The committed reservation window cannot cover the requested id
+    block — the caller must raft-reserve a fresh window (leader) or
+    redirect to whoever can (follower)."""
+
+
+class SequenceUnavailable(Exception):
+    """No reservation window could be committed: this master is not the
+    quorum leader (or lost its standing mid-reserve)."""
 
 
 class MemorySequencer:
@@ -114,6 +135,9 @@ class EtcdSequencer:  # pragma: no cover - driver-gated (no etcd in image)
 
     def _reserve_locked(self, need: int) -> None:
         """CAS-extend the etcd checkpoint until it covers `need` ids."""
+        # chaos site: a wedged/failed etcd reservation surfaces as a
+        # bounded assign error, never a silently reused id block
+        failpoints.sync_fail("master.etcd")
         tx = self._client.transactions
         while self._ceiling < need:
             raw, _ = self._client.get(self.KEY)
@@ -155,3 +179,122 @@ class EtcdSequencer:  # pragma: no cover - driver-gated (no etcd in image)
     def peek(self) -> int:
         with self._lock:
             return self._counter
+
+
+class RaftSequencer:
+    """Quorum-committed file-id allocator (multi-master ``-peers``).
+
+    Wraps any local sequencer and gates every allocation on a
+    raft-committed reservation window:
+
+    * the leader appends ``{"seq_reserve": n, "by": me}`` through
+      ``Election.append_command`` and hands out ids only after the
+      entry reaches commit index — ids are NEVER issued from an
+      uncommitted reservation;
+    * the window is RELATIVE: at apply time it becomes
+      ``[applied_seq, applied_seq + n)``, so windows partition the id
+      space in strict log order no matter how stale the reserving
+      leader's view was — a successor's first window always starts
+      above every window any deposed predecessor committed;
+    * a node claims a window for local allocation ONLY when it is the
+      author (``by == me``), the entry's term is its current term and
+      it still leads — every foreign window instead fences the local
+      counter past its end, so a follower promoted later starts above
+      everything ever reserved;
+    * a deposed leader may keep draining its already-committed window
+      (those ids live in the successor's committed log too — exactly
+      the acceptance contract), but the moment the window is spent it
+      gets no new one and the caller redirects.
+
+    Unissued ids in abandoned windows are simply burned — file keys
+    are sparse by design (same contract as the lease blocks the
+    ``-workers`` assign accelerators already abandon).
+    """
+
+    STEP = 4096                 # ids per reservation round trip
+
+    def __init__(self, inner, election, step: int = STEP):
+        self.inner = inner
+        self.election = election
+        self.step = step
+        # exclusive end of the newest APPLIED reservation window; the
+        # local counter sits inside [start, ceiling) only while a
+        # window claimed by THIS node's current leadership is open
+        self.ceiling = election.applied_seq
+        self.inner.set_max(self.ceiling - 1)
+        self.reserves = 0           # committed windows this process won
+        self._reserve_lock = asyncio.Lock()
+        election.adopt_seq_window = self.adopt_window
+
+    # -- applied-state hook (runs at commit index on every node) -------
+
+    def adopt_window(self, start: int, end: int, by: str,
+                     term: int) -> None:
+        if end <= self.ceiling:
+            return
+        self.ceiling = end
+        if by == self.election.me and term == self.election.term \
+                and self.election.is_leader:
+            # our own freshly committed window: open it for local
+            # allocation (counter may already sit inside it when
+            # heartbeat set_max pushed past the start)
+            self.inner.set_max(start - 1)
+            self.reserves += 1
+        else:
+            # a window some other leadership committed: fence the
+            # counter past it so this node can never re-issue from it
+            self.inner.set_max(end - 1)
+
+    # -- allocation ----------------------------------------------------
+
+    def next_file_id(self, count: int = 1) -> int:
+        """Allocate `count` consecutive ids inside the open committed
+        window; raises :class:`SequenceBehind` when the window cannot
+        cover the block (callers reserve, then retry)."""
+        if self.inner.peek() + count > self.ceiling:
+            raise SequenceBehind(
+                f"window exhausted at {self.ceiling}")
+        first = self.inner.next_file_id(count)
+        if first + count > self.ceiling:
+            # a racing set_max moved the counter past the window edge
+            # mid-allocation: burn the block, never hand out ids above
+            # the committed ceiling
+            raise SequenceBehind(
+                f"window burned at {self.ceiling}")
+        return first
+
+    async def reserve(self, count: int = 1) -> bool:
+        """Leader-only: raft-commit a window covering at least `count`
+        more ids. True when the window is committed AND claimed locally
+        (a True return makes the next ``next_file_id(count)`` succeed
+        barring racing ``set_max`` bumps)."""
+        async with self._reserve_lock:
+            # a queued waiter may find the window it needs already
+            # committed by the reserve it queued behind
+            if self.inner.peek() + count <= self.ceiling:
+                return True
+            # the window must cover `count` ids FROM ITS OWN START: the
+            # claim fences the counter to the window start, so sizing
+            # it only by the counter's current distance past the old
+            # ceiling would under-reserve any count > step and fail the
+            # leader's own assign forever. The peek()-based term still
+            # covers a heartbeat watermark that jumped the counter far
+            # past every committed window.
+            need = max(self.step, count,
+                       self.inner.peek() + count - self.ceiling)
+            ok = await self.election.append_command(
+                {"seq_reserve": need, "by": self.election.me})
+            # committed AND applied locally => adopt_window ran; the
+            # claim check is the window actually being usable (an
+            # entry committed by a SUCCESSOR after we lost the term
+            # applies as foreign and leaves the counter fenced)
+            return bool(ok) and \
+                self.inner.peek() + count <= self.ceiling
+
+    # -- passthrough (heartbeat watermark / UI) ------------------------
+
+    def set_max(self, seen: int) -> None:
+        self.inner.set_max(seen)
+
+    def peek(self) -> int:
+        return self.inner.peek()

@@ -26,7 +26,8 @@ from ..topology.tree import DataNode, Topology
 from ..security import tls
 from ..util import failpoints, glog, tracing
 from .election import Election
-from .sequence import MemorySequencer
+from .sequence import (MemorySequencer, RaftSequencer, SequenceBehind,
+                       SequenceUnavailable)
 
 
 class MasterServer:
@@ -95,16 +96,10 @@ class MasterServer:
             from .sequence import EtcdSequencer
             self.seq = EtcdSequencer(sequencer[5:])
         else:
-            if self._peers:
-                # after leader failover a fresh MemorySequencer only
-                # catches up via heartbeat set_max (one pulse behind), so
-                # ids issued by the old leader in the last interval would
-                # be re-issued and overwrite needles — multi-master needs
-                # a durable/shared sequencer (file:/etcd:)
-                glog.warning(
-                    "multi-master (-peers) with the in-memory sequencer "
-                    "can re-issue file ids across failover; use "
-                    "-sequencer file:<path> or etcd:<endpoints>")
+            # under -peers the raft log itself is the durable shared
+            # allocator: _make_election wraps this in a RaftSequencer,
+            # so even the in-memory sequencer is failover-safe (every
+            # issued id sits inside a quorum-committed window)
             self.seq = MemorySequencer()
         self.layouts: dict[LayoutKey, VolumeLayout] = {}
         self._watchers: list[asyncio.Queue] = []
@@ -134,8 +129,10 @@ class MasterServer:
     # /dir/lookup IS guarded like the reference's master_server.go:111 —
     # volume servers calling it during replica fan-out are auto-admitted
     # by _is_peer (their IP is learned from heartbeats), so an operator
-    # whitelist only needs to cover clients. Peer masters proxying
-    # follower requests must still be whitelisted (matches reference).
+    # whitelist only needs to cover clients. Follower control routes
+    # 307-redirect (the client IP is judged here, on the leader); only
+    # /submit still proxies, so peer master IPs need whitelisting for
+    # that route alone.
     _GUARDED = ("/dir/assign", "/dir/lookup", "/dir/status",
                 "/col/delete", "/vol/grow", "/vol/status", "/vol/vacuum",
                 "/vol/volumes", "/vol/ec_lookup", "/submit", "/stats/")
@@ -354,6 +351,13 @@ class MasterServer:
                         if self.meta_dir else None)))
         self.election.get_max_volume_id = lambda: self.topo.max_volume_id
         self.election.adopt_max_volume_id = self._adopt_max_volume_id
+        if self._peers and not isinstance(self.seq, RaftSequencer):
+            # multi-master: every fid block must come out of a
+            # quorum-committed reservation window — the raft log is
+            # the shared durable allocator (wrapping the configured
+            # file:/etcd: sequencer keeps its local durability as an
+            # extra floor under the committed ceiling)
+            self.seq = RaftSequencer(self.seq, self.election)
 
     def _raft_unready(self) -> web.Response | None:
         """503 while the Election is still being built (single mode
@@ -404,7 +408,8 @@ class MasterServer:
         body = await req.json()
         r = self.election.on_install_snapshot(
             int(body["term"]), body["leader"], int(body["last_index"]),
-            int(body["last_term"]), int(body.get("value", 0)))
+            int(body["last_term"]), int(body.get("value", 0)),
+            seq=int(body.get("seq", 0)))
         await self.election.flush()   # term bump / snapshot durable
         return web.json_response(r)
 
@@ -417,19 +422,42 @@ class MasterServer:
                 {"error": "no leader elected yet"}, status=503)
         return leader, None
 
+    def _redirect_to_leader(self, req: web.Request) -> web.Response:
+        """Follower answer for every control route: 307 to the leader
+        with the ``X-Raft-Leader`` hint (307 preserves method + body,
+        so aiohttp/urllib clients land on the leader transparently;
+        explicit fleet clients read the hint and re-home). Replaces
+        the old whole-body proxy — a follower must not buffer blobs,
+        and the whitelist decision belongs on the leader, judged by
+        the real client IP."""
+        leader, err = self._leader_or_503()
+        if err is not None:
+            return err
+        return web.json_response(
+            {"error": "not leader", "leader": leader}, status=307,
+            headers={"Location": tls.url(leader, req.path_qs),
+                     "X-Raft-Leader": leader})
+
     async def _proxy_to_leader(self, req: web.Request) -> web.Response:
         """Non-leader HTTP forwards to the leader
-        (proxyToLeader, master_server.go:153-185)."""
+        (proxyToLeader, master_server.go:153-185). Only /submit still
+        rides this (its multipart body is not reliably replayable
+        across a 307 by arbitrary clients); every other control route
+        redirects via _redirect_to_leader."""
         leader, err = self._leader_or_503()
         if err is not None:
             return err
         data = await req.read()
         # forward Content-Type: /submit interprets its body by it
         # (multipart vs raw), and dropping it would corrupt the upload
-        headers = {}
+        headers = {"X-Raft-Leader": leader}
         if "Content-Type" in req.headers:
             headers["Content-Type"] = req.headers["Content-Type"]
         try:
+            # chaos site: the follower->leader hop is a network hop
+            # like any other — error/latency/drop here must surface as
+            # a bounded 502 the client's seed rotation absorbs
+            await failpoints.fail("master.proxy")
             async with self._http.request(
                     req.method, tls.url(leader, f"{req.path_qs}"),
                     data=data or None, headers=headers) as resp:
@@ -439,6 +467,26 @@ class MasterServer:
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
             return web.json_response(
                 {"error": f"proxy to leader {leader}: {e}"}, status=502)
+
+    async def _next_fid(self, count: int) -> int:
+        """Allocate a fid block under the quorum discipline: ids come
+        only from a raft-committed reservation window; when the open
+        window cannot cover the block, the leader commits a fresh one
+        through the log FIRST (so a successor can never re-issue these
+        ids). Raises SequenceUnavailable when no window can be
+        committed — the caller errors/redirects, exactly the deposed
+        mid-assign contract."""
+        for _ in range(3):
+            try:
+                return self.seq.next_file_id(count)
+            except SequenceBehind:
+                if not isinstance(self.seq, RaftSequencer) \
+                        or not await self.seq.reserve(count):
+                    raise SequenceUnavailable(
+                        "no committed fid window (not leader?)") \
+                        from None
+        raise SequenceUnavailable("fid window kept burning under "
+                                  "racing heartbeat watermarks")
 
     # ---- handlers ----
 
@@ -452,10 +500,18 @@ class MasterServer:
 
     async def h_heartbeat(self, req: web.Request) -> web.Response:
         if not self.is_leader:
-            # volume servers must register with the leader; hand back the
-            # hint so they chase it (master_grpc_server.go:165-175)
-            return web.json_response(
-                {"rejected": True, "leader": self.leader_url or ""})
+            # volume servers must register with the leader. 307 lands
+            # this very pulse on the leader (aiohttp re-sends the JSON
+            # body), so a re-homing fleet loses ZERO pulses; the body
+            # keeps the legacy rejected+hint shape for clients that
+            # don't follow redirects (master_grpc_server.go:165-175)
+            leader = self.leader_url
+            if leader and leader != self.url:
+                return web.json_response(
+                    {"rejected": True, "leader": leader}, status=307,
+                    headers={"Location": tls.url(leader, req.path_qs),
+                             "X-Raft-Leader": leader})
+            return web.json_response({"rejected": True, "leader": ""})
         from ..stats import metrics
         if metrics.HAVE_PROMETHEUS:
             metrics.MASTER_RECEIVED_HEARTBEATS.inc()
@@ -510,8 +566,13 @@ class MasterServer:
                                1 << 20))
         except ValueError:
             return web.json_response({"error": "bad count"}, status=400)
-        return web.json_response(
-            {"start": self.seq.next_file_id(count), "count": count})
+        try:
+            start = await self._next_fid(count)
+        except SequenceUnavailable:
+            return web.json_response(
+                {"error": "not leader", "leader": self.leader_url or ""},
+                status=503)
+        return web.json_response({"start": start, "count": count})
 
     async def h_assign_state(self, req: web.Request) -> web.Response:
         """Writable-volume snapshot for one layout key — everything an
@@ -541,7 +602,7 @@ class MasterServer:
 
     async def h_assign(self, req: web.Request) -> web.Response:
         if not self.is_leader:
-            return await self._proxy_to_leader(req)
+            return self._redirect_to_leader(req)
         try:
             # chaos site: injected assign faults (error => client retry
             # with backoff; latency => client deadline discipline)
@@ -580,7 +641,17 @@ class MasterServer:
         from ..stats import metrics
         if metrics.HAVE_PROMETHEUS:
             metrics.MASTER_ASSIGN_REQUESTS.labels("ok").inc()
-        key = self.seq.next_file_id(count)
+        try:
+            key = await self._next_fid(count)
+        except SequenceUnavailable:
+            # deposed mid-assign: the in-flight request errors or
+            # redirects — it NEVER gets a fid outside a committed
+            # reservation window (tools/chaos.py ha's core invariant)
+            if not self.is_leader:
+                return self._redirect_to_leader(req)
+            return web.json_response(
+                {"error": "fid reservation lost quorum",
+                 "leader": self.leader_url or ""}, status=503)
         fid = str(t.FileId(vid, key, t.random_cookie()))
         nodes = self.topo.lookup(vid)
         node = nodes[0]
@@ -607,6 +678,13 @@ class MasterServer:
                 f"vid {vid}: MaxVolumeId not replicated to a quorum")
         prealloc = str(self.volume_size_limit
                        if self.volume_preallocate else 0)
+        # chaos site: the allocate fan-out to volume servers — an
+        # injected fault is a failed growth (PlacementError), never a
+        # half-registered volume the layout would hand out
+        try:
+            await failpoints.fail("master.grow")
+        except OSError as e:
+            raise PlacementError(f"injected grow fault: {e}") from e
         for n in nodes:
             async with self._http.post(
                     tls.url(n.url, "/admin/volume/allocate"),
@@ -627,7 +705,7 @@ class MasterServer:
 
     async def h_lookup(self, req: web.Request) -> web.Response:
         if not self.is_leader:
-            return await self._proxy_to_leader(req)
+            return self._redirect_to_leader(req)
         q = req.query
         vid_s = q.get("volumeId", "") or q.get("fileId", "")
         if "," in vid_s:
@@ -653,7 +731,7 @@ class MasterServer:
         the manual form of the auto-vacuum loop, same underlying
         check -> compact -> commit workflow."""
         if not self.is_leader:
-            return await self._proxy_to_leader(req)
+            return self._redirect_to_leader(req)
         from ..shell import volume_commands as vc
         from ..shell.env import CommandEnv
         try:
@@ -726,7 +804,8 @@ class MasterServer:
             if err is not None:
                 return err
             raise web.HTTPFound(
-                location=tls.url(leader, f"/{req.match_info['fid']}"))
+                location=tls.url(leader, f"/{req.match_info['fid']}"),
+                headers={"X-Raft-Leader": leader})
         fid = req.match_info["fid"]
         vid_s = fid.split(",")[0]
         try:
@@ -766,7 +845,7 @@ class MasterServer:
     async def h_volumes(self, req: web.Request) -> web.Response:
         """VolumeList analog: every volume + EC shard set with locations."""
         if not self.is_leader:
-            return await self._proxy_to_leader(req)
+            return self._redirect_to_leader(req)
         out = []
         for node in self.topo.all_nodes():
             out.append({
@@ -785,7 +864,7 @@ class MasterServer:
     async def h_ec_lookup(self, req: web.Request) -> web.Response:
         """vid -> {shard_id: [urls]} (LookupEcVolume, topology_ec.go:97-133)."""
         if not self.is_leader:
-            return await self._proxy_to_leader(req)
+            return self._redirect_to_leader(req)
         vid = int(req.query["volumeId"])
         by_shard = self.topo.ec_shard_locations.get(vid)
         if not by_shard:
@@ -805,7 +884,7 @@ class MasterServer:
 
     async def h_grow(self, req: web.Request) -> web.Response:
         if not self.is_leader:
-            return await self._proxy_to_leader(req)
+            return self._redirect_to_leader(req)
         q = req.query
         collection = q.get("collection", "")
         replication = q.get("replication", "") or self.default_replication
@@ -826,17 +905,26 @@ class MasterServer:
 
     async def h_collection_delete(self, req: web.Request) -> web.Response:
         if not self.is_leader:
-            return await self._proxy_to_leader(req)
+            return self._redirect_to_leader(req)
         collection = req.query.get("collection", "")
         deleted = []
         for node in self.topo.all_nodes():
             vids = [m.id for m in node.volumes.values()
                     if m.collection == collection]
             for vid in vids:
-                async with self._http.post(
-                        tls.url(node.url, "/admin/volume/delete"),
-                        params={"volume": str(vid)}) as resp:
-                    await resp.read()
+                # chaos site: per-holder delete dispatch — a failed hop
+                # surfaces as a bounded 503 with the partial result
+                try:
+                    await failpoints.fail("master.col_delete")
+                    async with self._http.post(
+                            tls.url(node.url, "/admin/volume/delete"),
+                            params={"volume": str(vid)}) as resp:
+                        await resp.read()
+                except (aiohttp.ClientError, asyncio.TimeoutError,
+                        OSError) as e:
+                    return web.json_response(
+                        {"error": f"delete vid {vid} on {node.url}: {e}",
+                         "deleted": sorted(set(deleted))}, status=503)
                 deleted.append(vid)
         return web.json_response({"deleted": sorted(set(deleted))})
 

@@ -30,6 +30,7 @@ import asyncio
 import json
 import re
 
+from ..master.sequence import SequenceBehind
 from ..util import tracing
 from ..util.failpoints import pending as _fp_pending
 from ..util.frame import MAGIC as _FRAME_MAGIC
@@ -576,8 +577,13 @@ class FastAssignProtocol(asyncio.Protocol):
         vid = lay.pick_for_write(ms.topo, rp.copy_count)
         if vid is None:
             return None             # growth: serialized in aiohttp
+        try:
+            key = ms.seq.next_file_id(count)
+        except SequenceBehind:
+            # committed fid window spent: the full handler raft-commits
+            # a fresh reservation before answering (multi-master)
+            return None
         ms.count_assign()
-        key = ms.seq.next_file_id(count)
         fid = str(t.FileId(vid, key, t.random_cookie()))
         node = ms.topo.lookup(vid)[0]
         out = {"fid": fid, "url": node.url, "publicUrl": node.public_url,

@@ -26,6 +26,23 @@ if HAVE_PROMETHEUS:
     MASTER_RECEIVED_HEARTBEATS = Counter(
         "SeaweedFS_master_received_heartbeats", "heartbeats received",
         registry=REGISTRY)
+    # HA master quorum (master/election.py): the raft state every
+    # failover dashboard needs — whose term, how far committed, and
+    # who leads. All three are identities of ONE process, not additive
+    # quantities: they join NON_ADDITIVE_GAUGE_PREFIXES below so a
+    # -workers merged host reports the max (one leader), never a sum
+    # (two "leaders", a doubled term)
+    RAFT_TERM = Gauge(
+        "SeaweedFS_raft_term",
+        "current raft term of this master", registry=REGISTRY)
+    RAFT_COMMIT_INDEX = Gauge(
+        "SeaweedFS_raft_commit_index",
+        "highest raft log index known committed on this master",
+        registry=REGISTRY)
+    RAFT_IS_LEADER = Gauge(
+        "SeaweedFS_raft_is_leader",
+        "1 when this master is the elected (or single-mode) leader",
+        registry=REGISTRY)
     MASTER_ASSIGN_REQUESTS = Counter(
         "SeaweedFS_master_assign_requests", "assign requests",
         ["status"], registry=REGISTRY)
@@ -237,6 +254,10 @@ NON_ADDITIVE_GAUGE_PREFIXES = (
     "SeaweedFS_build_info",
     "SeaweedFS_process_start_time_seconds",
     "SeaweedFS_slo_",
+    # raft identity gauges (term / commit_index / is_leader): summing
+    # across a merged host would report 2 leaders the moment any two
+    # workers each said "1" — the host's honest answer is the max
+    "SeaweedFS_raft_",
 )
 _NON_ADDITIVE_B = tuple(p.encode() for p in NON_ADDITIVE_GAUGE_PREFIXES)
 

@@ -177,16 +177,31 @@ class WeedClient:
                                 timeout=MASTER_TIMEOUT) as resp:
                             body = await resp.json()
                             if resp.status in (502, 503):
-                                # reachable follower proxying a dead
-                                # leader / no leader yet: the NEXT seed
+                                # no leader yet / quorum lost: chase an
+                                # explicit leader hint when the reply
+                                # carries one (X-Raft-Leader rides every
+                                # follower answer), else the NEXT seed
                                 # may already be the new leader
                                 last = body.get("error",
                                                 f"http {resp.status}")
                                 br.record_success()  # reachable, not broken
-                                sp.event("seed_rotate",
-                                         status=resp.status)
-                                self._rotate_seed()
+                                hint = (body.get("leader", "")
+                                        or resp.headers.get(
+                                            "X-Raft-Leader", ""))
+                                if hint and hint != self.master_url:
+                                    sp.event("leader_hint", leader=hint)
+                                    self.master_url = hint
+                                else:
+                                    sp.event("seed_rotate",
+                                             status=resp.status)
+                                    self._rotate_seed()
                                 continue
+                            if resp.history and resp.url.port:
+                                # a follower 307'd us to the leader:
+                                # remember it so the next request goes
+                                # straight there (no redirect hop)
+                                self._learn_master(
+                                    f"{resp.url.host}:{resp.url.port}")
                             br.record_success()
                             sp.status = "ok"
                             return body
@@ -208,6 +223,16 @@ class WeedClient:
                  if self.master_url in self.master_seeds else -1)
             self.master_url = self.master_seeds[
                 (i + 1) % len(self.master_seeds)]
+
+    def _learn_master(self, leader: str) -> None:
+        """Adopt a leader learned from a 307/hint; fold it into the
+        seed rotation so a later death of THIS leader still rotates
+        through every master we ever met."""
+        if not leader:
+            return
+        if leader not in self.master_seeds:
+            self.master_seeds.append(leader)
+        self.master_url = leader
 
     def attach_master_client(self, mc) -> None:
         """Route lookups through a watch-fed MasterClient

@@ -60,6 +60,13 @@ TYPES = frozenset({
                                 # (unrepairable, no target, cooldown,
                                 # queue-full, paused-too-long)
     "autopilot_pause",          # repair parked: /debug/health paged
+    "raft_leader_change",       # this master observed a new quorum
+                                # leader (election win, or a pulse from
+                                # a successor) — wall_ms deltas across
+                                # the fleet bound the failover window
+    "raft_step_down",           # a LEADER lost its standing (lease
+                                # expiry under partition, or a higher
+                                # term appeared) and stopped assigning
 })
 
 _MAX_FIELDS = 16                # per-event field cap (bounded memory)

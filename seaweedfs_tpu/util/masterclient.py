@@ -185,7 +185,13 @@ class MasterClient:
                         continue
                     if update.get("leader"):
                         # explicit leader hint (sent by non-leader masters
-                        # in an HA deployment): reconnect there
-                        self.current_master = update["leader"]
-                        raise _LeaderRedirect(update["leader"])
+                        # in an HA deployment): reconnect there, and fold
+                        # the learned leader into the rotation so its own
+                        # later death still rotates through every master
+                        # this client ever met
+                        lead = update["leader"]
+                        if lead not in self.masters:
+                            self.masters.append(lead)
+                        self.current_master = lead
+                        raise _LeaderRedirect(lead)
                     self._apply(update)

@@ -395,6 +395,54 @@ def test_executor_halts_on_leadership_loss():
     run(body())
 
 
+def test_cycle_halts_when_deposed_between_plan_and_execute():
+    """HA quorum discipline: a leader deposed mid-cycle (after its
+    observation, before execution) must execute NOTHING — the planned
+    actions were derived from a leadership that no longer exists, and
+    the successor's autopilot owns the cluster from ITS observation."""
+    async def body():
+        from seaweedfs_tpu.autopilot.controller import Autopilot
+
+        state = {"leader": True}
+
+        class FakeMaster:
+            @property
+            def is_leader(self):
+                return state["leader"]
+
+        ap = Autopilot(FakeMaster())
+        dispatched = []
+
+        async def fake_snapshot():
+            return ClusterSnapshot(), []
+        ap.observer.snapshot = fake_snapshot
+
+        def fake_plan(snap, cfg):
+            # deposition lands exactly between plan and execute
+            state["leader"] = False
+            return [Action(kind="vacuum_volume", vid=1,
+                           holders=("10.0.0.1:801",))], []
+        import seaweedfs_tpu.autopilot.controller as ctl
+        orig_plan = ctl.plan
+        ctl.plan = fake_plan
+
+        async def spy_post(url, path, params, timeout_s=60.0):
+            dispatched.append((url, path))
+            return {"ok": True}
+        ap.executor.node_post = spy_post
+        try:
+            report = await ap.run_cycle()
+        finally:
+            ctl.plan = orig_plan
+        assert report["halted"] == "lost leadership"
+        assert report["executed"] == []
+        assert len(report["planned"]) == 1   # the plan WAS made...
+        assert dispatched == []              # ...and nothing ran
+        assert ap.state == "follower"
+        assert ap.actions_ok == 0 and ap.actions_failed == 0
+    run(body())
+
+
 # ---------------------------------------------------------------------------
 # live cluster: the rebuild-to-target route + full heal cycles
 # ---------------------------------------------------------------------------

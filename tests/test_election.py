@@ -72,11 +72,11 @@ async def _body_single_leader():
             await m.stop()
 
 
-def test_follower_proxies_assign_and_status(tmp_path):
-    run(_body_proxy(tmp_path))
+def test_follower_redirects_assign_and_status(tmp_path):
+    run(_body_redirect(tmp_path))
 
 
-async def _body_proxy(tmp_path):
+async def _body_redirect(tmp_path):
     masters = await _make_cluster(3)
     vs = None
     try:
@@ -85,13 +85,14 @@ async def _body_proxy(tmp_path):
 
         store = Store([os.path.join(str(tmp_path), "v0")],
                       max_volume_counts=[8])
-        # point the volume server at a follower: the rejected heartbeat
-        # must redirect it to the leader
+        # point the volume server at a follower: the 307 lands THIS
+        # pulse on the leader (re-homing costs zero pulses) and the
+        # hint re-points master_url for the next one
         vs = VolumeServer(store, follower.url, port=0, pulse_seconds=0.1)
         await vs.start()
-        await vs.heartbeat_once()   # rejected, learns leader
+        assert await vs.heartbeat_once()   # redirected => registered
         assert vs.master_url == leader.url
-        await vs.heartbeat_once()   # registers with leader
+        assert any(n.url == vs.url for n in leader.topo.all_nodes())
 
         async with aiohttp.ClientSession() as http:
             async with http.get(
@@ -99,7 +100,16 @@ async def _body_proxy(tmp_path):
                 st = await resp.json()
             assert st["isLeader"] is False
             assert st["leader"] == leader.url
-            # assign via follower is proxied to the leader
+            # assign via follower: 307-redirect-to-leader with the
+            # X-Raft-Leader hint on the wire...
+            async with http.post(f"http://{follower.url}/dir/assign",
+                                 allow_redirects=False) as resp:
+                assert resp.status == 307
+                assert resp.headers["X-Raft-Leader"] == leader.url
+                assert leader.url in resp.headers["Location"]
+                hint = await resp.json()
+            assert hint["leader"] == leader.url
+            # ...which a default client follows transparently
             async with http.post(
                     f"http://{follower.url}/dir/assign") as resp:
                 body = await resp.json()
@@ -185,9 +195,14 @@ async def _body_failover(tmp_path):
 
         # grow a volume so MaxVolumeId advances on the leader, then verify
         # the replicated value reached followers via leader pulses
+        issued = []
         async with aiohttp.ClientSession() as http:
-            async with http.post(f"http://{leader.url}/dir/assign") as resp:
-                assert (await resp.json()).get("fid")
+            for _ in range(4):
+                async with http.post(
+                        f"http://{leader.url}/dir/assign") as resp:
+                    fid = (await resp.json()).get("fid")
+                    assert fid
+                    issued.append(fid)
         await asyncio.sleep(0.3)
         grown_vid = leader.topo.max_volume_id
         assert grown_vid >= 1
@@ -214,6 +229,21 @@ async def _body_failover(tmp_path):
             await asyncio.sleep(0.05)
         assert vs.master_url == new_leader.url
         assert any(n.url == vs.url for n in new_leader.topo.all_nodes())
+
+        # zero duplicate fids across the failover: every (vid, key) the
+        # old leader issued came from a quorum-committed reservation
+        # window, so the successor's assigns land strictly above them
+        from seaweedfs_tpu.storage.types import FileId
+        async with aiohttp.ClientSession() as http:
+            for _ in range(6):
+                async with http.post(
+                        f"http://{new_leader.url}/dir/assign") as resp:
+                    body = await resp.json()
+                    assert "fid" in body, body
+                    issued.append(body["fid"])
+        keys = [(f.volume_id, f.key)
+                for f in map(FileId.parse, issued)]
+        assert len(set(keys)) == len(keys), f"duplicate fid: {issued}"
     finally:
         if vs:
             await vs.stop()

@@ -357,6 +357,28 @@ def test_failpoint_site_covers_ec_recovery_plane(tmp_path):
     assert found == []
 
 
+def test_failpoint_site_covers_master_control_plane(tmp_path):
+    """The HA control plane (master/) is in failpoint scope: a raft
+    RPC or follower->leader hop without a site in reach is a quorum
+    path tools/chaos.py ha can never partition."""
+    found = probs(tmp_path, """
+        async def send(self, peer, batch):
+            async with self._http.post(peer, json=batch) as r:
+                return await r.json()
+    """, name="seaweedfs_tpu/master/election.py",
+        select=["failpoint-site"])
+    assert rule_ids(found) == ["failpoint-site"]
+    found = probs(tmp_path, """
+        from seaweedfs_tpu.util import failpoints
+        async def send(self, peer, batch):
+            await failpoints.fail("master.append")
+            async with self._http.post(peer, json=batch) as r:
+                return await r.json()
+    """, name="seaweedfs_tpu/master/server.py",
+        select=["failpoint-site"])
+    assert found == []
+
+
 def test_executor_ctx_fires_on_raw_run_in_executor(tmp_path):
     found = probs(tmp_path, """
         import asyncio

@@ -97,6 +97,14 @@ if ! timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/soak.py heal --quick; 
     exit 1
 fi
 
+echo "== ha quorum smoke (chaos ha --quick: leader SIGKILL -> zero lost/dup fids) =="
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/chaos.py ha --quick; then
+    echo "ha smoke: FAILED (the master quorum lost or duplicated a fid"
+    echo "across a leader kill, failover blew the 2-election-timeout"
+    echo "bound, or the autopilot ran on a follower; see output above)"
+    exit 1
+fi
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \

@@ -79,7 +79,7 @@ def main() -> int:
     try:
         spawn("master", "-port", str(PORT), "-mdir",
               os.path.join(tmp, "m"), "-pulseSeconds", "1",
-              "-autopilot.dryrun")
+              "-autopilot.dryrun", "-timeline.interval", "2")
         time.sleep(1.5)
         spawn("volume", "-port", str(PORT + 1), "-dir",
               os.path.join(tmp, "v"), "-max", "10", "-master", master,
@@ -180,6 +180,24 @@ def main() -> int:
             check(key in cyc, f"scrub cycle missing {key!r}")
         print(f"  scrub: {len(sc['workers'])} workers merged, cycle "
               f"keys OK")
+
+        # -- raft surfaces on the master (HA control plane schema) ------
+        mtl = get_json(master, "/debug/timeline?snap=1", method="POST")
+        mg = mtl["windows"][-1]["gauges"]
+        for key in ("SeaweedFS_raft_term", "SeaweedFS_raft_commit_index",
+                    "SeaweedFS_raft_is_leader"):
+            check(key in mg, f"master timeline missing {key!r} gauge")
+        check(mg["SeaweedFS_raft_is_leader"] == 1,
+              "single-mode master not reporting raft_is_leader=1")
+        mev = get_json(master, "/debug/events?n=200"
+                       "&type=raft_leader_change")
+        check(mev["events"], "no raft_leader_change journal row on a "
+                             "booted master")
+        lead = mev["events"][0]
+        for key in ("leader", "term", "me"):
+            check(key in lead, f"raft_leader_change row missing {key!r}")
+        print(f"  raft: is_leader gauge + leader_change journal OK "
+              f"(term {int(mg['SeaweedFS_raft_term'])})")
 
         # -- /debug/autopilot (forced dry-run cycle) --------------------
         ap = get_json(master, "/debug/autopilot")["autopilot"]

@@ -1222,11 +1222,24 @@ async def scenario_heal(tmp: str) -> int:
                         "-scrub.mbps", "50",
                         "-scrub.pausems", "500")
         await wait_assign(master)
+
+        # pre-grow a second volume: on a fast container the fill below
+        # outruns the heartbeat-fed size accounting (every write lands
+        # before vid 1 ever reports size >= limit, so the layout never
+        # rolls), and the scenario NEEDS >= 2 EC volumes to plant rot
+        # in — offer two up front and let pick_for_write spread data
+        def pregrow() -> None:
+            req = urllib.request.Request(
+                f"http://{master}/vol/grow?count=1&replication=000",
+                method="POST")
+            with urllib.request.urlopen(req, timeout=15) as r:
+                r.read()
+        await asyncio.to_thread(pregrow)
+
         rng = random.Random(2026)
         payloads: dict = {}
         async with WeedClient(master) as c:
-            # enough bytes to roll past -volumeSizeLimitMB at least
-            # once: the scenario NEEDS >= 2 EC volumes to plant rot in
+            # enough bytes to roll past -volumeSizeLimitMB at least once
             await fill(c, payloads, 500 if quick else 900, rng,
                        replication="000")
             await asyncio.to_thread(

@@ -44,7 +44,13 @@ FAILPOINT_SCOPE = ("seaweedfs_tpu/server/", "seaweedfs_tpu/replication/",
                    # the autopilot maintenance plane: chaos.py must be
                    # able to break the healer itself (observe probes,
                    # executor dispatch)
-                   "seaweedfs_tpu/autopilot/")
+                   "seaweedfs_tpu/autopilot/",
+                   # the HA control plane: every raft RPC (vote/append/
+                   # snapshot), the follower->leader proxy hop, the
+                   # grow/delete fan-outs and the etcd id reservation
+                   # must sit within chaos-site reach — tools/chaos.py
+                   # ha partitions the quorum through them
+                   "seaweedfs_tpu/master/")
 
 
 def _mentions_evidence(fn: ast.AST, spec: re.Pattern) -> bool:
