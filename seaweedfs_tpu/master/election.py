@@ -35,6 +35,7 @@ import time
 import aiohttp
 
 from ..util import events, failpoints, glog, tracing
+from ..util.frame import FrameChannelError, FrameHub
 
 # compact the log once it outgrows this many entries (each entry is one
 # volume-id bump; the reference's raft snapshots on a size threshold too)
@@ -56,7 +57,8 @@ class Election:
     def __init__(self, me: str, peers: list[str],
                  election_timeout: tuple[float, float] = (1.0, 2.0),
                  pulse: float = 0.3,
-                 state_path: str | None = None):
+                 state_path: str | None = None,
+                 jwt_key: str = ""):
         self.me = self._norm(me)
         # peers excludes self (normalized, so localhost == 127.0.0.1);
         # empty peers == single-master mode
@@ -124,6 +126,12 @@ class Election:
         # reserving leader claims the window it committed
         self.adopt_seq_window = lambda start, end, by, term: None
         self._http: aiohttp.ClientSession | None = None
+        # frame fabric: one persistent multiplexed channel per raft
+        # peer (HELLO identity signed with the cluster jwt key when
+        # set), with per-attempt channel deadlines; any frame failure
+        # falls back to the aiohttp POST below
+        self.jwt_key = jwt_key
+        self.frame_hub: FrameHub | None = None
         self._task: asyncio.Task | None = None
         # deferred-durability machinery: sync mutators mark, async
         # call sites flush before the state is acted on
@@ -276,6 +284,9 @@ class Election:
             return
         self._http = tls.make_session(
             timeout=aiohttp.ClientTimeout(total=max(self.pulse * 2, 0.5)))
+        self.frame_hub = FrameHub(ssl=tls.client_ctx(),
+                                  jwt_key=self.jwt_key,
+                                  request_timeout=self.attempt_timeout)
         self.last_pulse = time.monotonic()
         self._task = asyncio.create_task(self._loop())
 
@@ -295,6 +306,8 @@ class Election:
         except OSError as e:
             glog.warning("%s: final raft-state flush failed: %s",
                          self.me, e)
+        if self.frame_hub:
+            await self.frame_hub.close()
         if self._http:
             await self._http.close()
 
@@ -446,6 +459,34 @@ class Election:
             self.role = self.FOLLOWER
             self._update_gauges()
 
+    # ---- outgoing RPC transport (frames first, HTTP fallback) ----
+
+    async def _raft_rpc(self, peer: str, path: str,
+                        payload: dict) -> dict:
+        """POST one raft RPC to `peer`, riding the persistent frame
+        channel when the peer speaks it and dropping to the aiohttp
+        session otherwise. The caller supplies the per-attempt
+        wait_for; the channel deadline here bounds the frame leg so a
+        refused/sick channel still leaves time for the HTTP leg."""
+        if self.frame_hub is not None:
+            try:
+                # chaos site: force the frame leg down so chaos/ha
+                # proves raft stays correct on the HTTP fallback
+                await failpoints.fail("master.raft.frame")
+                chan = self.frame_hub.get(target=peer)
+                status, _, body = await chan.request(
+                    "POST", path,
+                    headers={"content-type": "application/json"},
+                    body=json.dumps(payload).encode(),
+                    timeout=self.attempt_timeout)
+                if status == 200:
+                    return json.loads(body)
+            except (FrameChannelError, OSError, ValueError):
+                pass    # breaker-open / severed / refused -> HTTP
+        async with self._http.post(tls.url(peer, path),
+                                   json=payload) as resp:
+            return await resp.json()
+
     # ---- the election / heartbeat loop ----
 
     async def _loop(self) -> None:
@@ -486,15 +527,12 @@ class Election:
                 # the next election-timeout fire
                 async def one() -> dict:
                     await failpoints.fail("master.vote")
-                    async with self._http.post(
-                            tls.url(peer, "/raft/vote"),
-                            json={"term": term, "candidate": self.me,
-                                  "last_log_index": self.last_index(),
-                                  "last_log_term": self.last_log_term(),
-                                  "max_volume_id":
-                                      self.get_max_volume_id()},
-                    ) as resp:
-                        return await resp.json()
+                    return await self._raft_rpc(
+                        peer, "/raft/vote",
+                        {"term": term, "candidate": self.me,
+                         "last_log_index": self.last_index(),
+                         "last_log_term": self.last_log_term(),
+                         "max_volume_id": self.get_max_volume_id()})
                 body = await asyncio.wait_for(one(), self.attempt_timeout)
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
                 return False
@@ -543,17 +581,13 @@ class Election:
                     # partition mid-catch-up
                     async def snap_rpc() -> dict:
                         await failpoints.fail("master.snapshot")
-                        async with self._http.post(
-                                tls.url(peer, "/raft/snapshot"),
-                                json={"term": self.term,
-                                      "leader": self.me,
-                                      "last_index":
-                                          self.snap["last_index"],
-                                      "last_term":
-                                          self.snap["last_term"],
-                                      "value": self.snap["value"],
-                                      "seq": self.snap["seq"]}) as resp:
-                            return await resp.json()
+                        return await self._raft_rpc(
+                            peer, "/raft/snapshot",
+                            {"term": self.term, "leader": self.me,
+                             "last_index": self.snap["last_index"],
+                             "last_term": self.snap["last_term"],
+                             "value": self.snap["value"],
+                             "seq": self.snap["seq"]})
                     reply = await asyncio.wait_for(snap_rpc(),
                                                    self.attempt_timeout)
                     if reply.get("term", 0) > self.term:
@@ -576,19 +610,16 @@ class Election:
                 # past the lease/pulse cadence.
                 async def append_rpc() -> dict:
                     await failpoints.fail("master.append")
-                    async with self._http.post(
-                            tls.url(peer, "/raft/heartbeat"),
-                            json={"term": self.term, "leader": self.me,
-                                  "prev_index": prev,
-                                  "prev_term": self._term_at(prev) or 0,
-                                  "entries": batch,
-                                  "commit": self.commit,
-                                  # legacy field so a mid-upgrade peer
-                                  # still adopts the watermark
-                                  "max_volume_id":
-                                      self.get_max_volume_id()},
-                    ) as resp:
-                        return await resp.json()
+                    return await self._raft_rpc(
+                        peer, "/raft/heartbeat",
+                        {"term": self.term, "leader": self.me,
+                         "prev_index": prev,
+                         "prev_term": self._term_at(prev) or 0,
+                         "entries": batch,
+                         "commit": self.commit,
+                         # legacy field so a mid-upgrade peer still
+                         # adopts the watermark
+                         "max_volume_id": self.get_max_volume_id()})
                 reply = await asyncio.wait_for(append_rpc(),
                                                self.attempt_timeout)
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):

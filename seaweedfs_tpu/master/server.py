@@ -219,6 +219,14 @@ class MasterServer:
     def url(self) -> str:
         return f"{self.ip}:{self.port}"
 
+    def frame_protocol(self):
+        """Factory for the master-side frame terminator — the hook
+        FastAssignProtocol's MAGIC sniff upgrades onto (the assign
+        accelerator has no such surface, so frames there degrade to
+        HTTP)."""
+        from .frameadapter import MasterFrameProtocol
+        return MasterFrameProtocol(self)
+
     async def start(self) -> None:
         self._http = tls.make_session(
             timeout=aiohttp.ClientTimeout(total=30))
@@ -348,7 +356,8 @@ class MasterServer:
             election_timeout=self._election_timeout,
             pulse=self._election_pulse,
             state_path=(os.path.join(self.meta_dir, "raft_state.json")
-                        if self.meta_dir else None)))
+                        if self.meta_dir else None),
+            jwt_key=self.jwt_key))
         self.election.get_max_volume_id = lambda: self.topo.max_volume_id
         self.election.adopt_max_volume_id = self._adopt_max_volume_id
         if self._peers and not isinstance(self.seq, RaftSequencer):

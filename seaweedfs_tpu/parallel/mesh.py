@@ -31,6 +31,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6 exposes shard_map at top level
+    _shard_map = jax.shard_map
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        # older jax spells the replication-check kwarg check_rep
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)
+
 from ..ec import gf
 from ..ops.gf256_pallas import (gf256_stacked_transform, u8_to_words,
                                 words_to_u8)
@@ -87,7 +97,7 @@ def _encode_fn(mesh: Mesh):
         parity = _stacked_apply(consts, d)
         return jnp.concatenate([d, parity], axis=-2)
 
-    return jax.jit(jax.shard_map(local, mesh=mesh,
+    return jax.jit(_shard_map(local, mesh=mesh,
                                  in_specs=P("vol", None, "shard"),
                                  out_specs=P("vol", None, "shard"),
                                  check_vma=False))
@@ -130,7 +140,7 @@ def _rebuild_fn(mesh: Mesh, present_rows: tuple, want_rows: tuple):
                                             axis=2)
         return _stacked_apply(consts, mine)
 
-    return jax.jit(jax.shard_map(local, mesh=mesh,
+    return jax.jit(_shard_map(local, mesh=mesh,
                                  in_specs=P("vol", "shard", None),
                                  out_specs=P("vol", None, "shard"),
                                  check_vma=False))
@@ -172,7 +182,7 @@ def _verify_fn(mesh: Mesh):
         # the per-volume verdict global
         return jax.lax.psum(bad, "shard")
 
-    return jax.jit(jax.shard_map(local, mesh=mesh,
+    return jax.jit(_shard_map(local, mesh=mesh,
                                  in_specs=P("vol", None, "shard"),
                                  out_specs=P("vol"),
                                  check_vma=False))

@@ -517,6 +517,20 @@ class FastAssignProtocol(asyncio.Protocol):
     def data_received(self, data: bytes) -> None:
         self.buf += data
         while not self._closed:
+            if self.buf[:1] == _FRAME_MAGIC[:1]:
+                # binary frame preamble (util/frame.py): raft RPCs,
+                # volume heartbeats and client lookups ride the frame
+                # fabric onto this same public port — swap protocols
+                # in place once the magic is complete, exactly like
+                # the volume side's raw listener
+                if self.buf.startswith(_FRAME_MAGIC):
+                    self._upgrade_frames()
+                    return
+                if len(self.buf) < len(_FRAME_MAGIC) and \
+                        _FRAME_MAGIC.startswith(bytes(self.buf)):
+                    return            # preamble still arriving
+                self._upgrade()       # same first byte, not the magic
+                return
             head_end = self.buf.find(b"\r\n\r\n")
             if head_end < 0:
                 if len(self.buf) > 32 * 1024:
@@ -600,6 +614,26 @@ class FastAssignProtocol(asyncio.Protocol):
     def _upgrade(self) -> None:
         proto = self.ms._runner.server()
         raw = bytes(self.buf)
+        self.buf.clear()
+        self._closed = True
+        getattr(self.ms, "_fast_conns", set()).discard(self.transport)
+        self.transport.set_protocol(proto)
+        proto.connection_made(self.transport)
+        if raw:
+            proto.data_received(raw)
+
+    def _upgrade_frames(self) -> None:
+        """Swap onto the master's frame terminator
+        (master/frameadapter.py). The assign ACCELERATOR worker has no
+        frame surface (its `ms` is not a MasterServer) — there a frame
+        preamble upgrades onto the proxy app, which closes the
+        connection and the client's channel falls back to HTTP."""
+        factory = getattr(self.ms, "frame_protocol", None)
+        if factory is None:
+            self._upgrade()
+            return
+        proto = factory()
+        raw = bytes(self.buf[len(_FRAME_MAGIC):])
         self.buf.clear()
         self._closed = True
         getattr(self.ms, "_fast_conns", set()).discard(self.transport)

@@ -20,9 +20,13 @@ Request routing over frames:
 * ``/admin/ec/shard_read`` — the batched EC shard gather.
 
 Anything the frame transport cannot express (chunked-manifest
-assembly, jwt-guarded writes on an untokened connection, multipart)
-answers with ``FLAG_FALLBACK`` and the caller retries over HTTP —
-the exact degradation a peer that predates the protocol produces.
+assembly, jwt-guarded writes on an identity-less connection,
+multipart) answers with ``FLAG_FALLBACK`` and the caller retries over
+HTTP — the exact degradation a peer that predates the protocol
+produces. Replica fan-out writes (x-raw-needle) and replicate-typed
+deletes are served over frames under the same -whiteList policy the
+HTTP listeners apply; on jwt-secured clusters the HELLO handshake
+itself refuses connections without a verified identity claim.
 
 Under ``-workers``, a frame request for a sibling-owned vid arriving
 WITHOUT the launch token is forwarded over the server's own sibling
@@ -46,17 +50,17 @@ _OPS = {"GET": "read", "HEAD": "read", "POST": "write", "PUT": "write",
         "DELETE": "delete"}
 
 
-def _count_frames(side: str, n: int = 1) -> None:
+def _count_frames(side: str, hop: str, n: int = 1) -> None:
     from ..stats import metrics
     if metrics.HAVE_PROMETHEUS:
-        metrics.FRAME_REQUESTS.labels(side).inc(n)
+        metrics.FRAME_REQUESTS.labels(side, hop).inc(n)
 
 
 class FrameServerProtocol(asyncio.Protocol):
     """Per-connection frame terminator (server side)."""
 
-    __slots__ = ("vs", "transport", "peer_ip", "dec", "hop", "_hello",
-                 "_closed", "_tasks", "_write_lock", "_pre")
+    __slots__ = ("vs", "transport", "peer_ip", "dec", "hop", "authed",
+                 "_hello", "_closed", "_tasks", "_write_lock", "_pre")
 
     def __init__(self, vs) -> None:
         self.vs = vs
@@ -64,6 +68,9 @@ class FrameServerProtocol(asyncio.Protocol):
         self.peer_ip: str | None = None
         self.dec = FrameDecoder()
         self.hop = False              # token-authenticated worker hop
+        # cluster identity: worker token OR a verified jwt HELLO claim
+        # (frames from a peer holding the cluster signing key)
+        self.authed = False
         self._hello = False
         self._closed = False
         self._tasks: set = set()
@@ -148,6 +155,16 @@ class FrameServerProtocol(asyncio.Protocol):
             wc = self.vs.worker_ctx
             token = str(fr.meta.get("token", "") or "")
             self.hop = wc is not None and wc.token_ok(token)
+            self.authed = self.hop or self._verify_identity(
+                str(fr.meta.get("id", "") or ""))
+            if getattr(self.vs, "jwt_key", "") and not self.authed:
+                # jwt-secured cluster: an unauthenticated (or wrong-
+                # identity) HELLO is refused BEFORE any payload is
+                # served — connection identity is part of the security
+                # model, not a courtesy
+                self._goaway("hello identity required "
+                             "(jwt-secured cluster)")
+                return
             self._hello = True
             self.transport.write(encode_frame(
                 HELLO_OK, fr.req_id,
@@ -159,8 +176,32 @@ class FrameServerProtocol(asyncio.Protocol):
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
+    def _verify_identity(self, ident: str) -> bool:
+        """A HELLO ``id`` claim: a jwt minted from the cluster signing
+        key, bound to the fixed handshake fid (util/frame.py
+        HELLO_IDENTITY_FID) so per-needle write tokens can never be
+        replayed as channel identities."""
+        key = getattr(self.vs, "jwt_key", "")
+        if not key or not ident:
+            return False
+        from ..security.jwt import JwtError, decode_jwt
+        from ..util.frame import HELLO_IDENTITY_FID
+        try:
+            return decode_jwt(key, ident).get(
+                "fid") == HELLO_IDENTITY_FID
+        except JwtError:
+            return False
+
+    def _hop_label(self) -> str:
+        """Low-cardinality hop classification for the server-side
+        counters: the launch-token worker hop and unix-socket
+        connections are intra-host siblings, everything else is the
+        inter-host fabric."""
+        return "sibling" if (self.hop or self.peer_ip is None) \
+            else "interhost"
+
     async def _serve(self, fr) -> None:
-        _count_frames("server")
+        _count_frames("server", self._hop_label())
         req_id = fr.req_id
         method = str(fr.meta.get("m", "GET")).upper()
         path = str(fr.meta.get("p", ""))
@@ -237,19 +278,26 @@ class FrameServerProtocol(asyncio.Protocol):
     def _external_mutation_gate(self, method: str, query: dict,
                                 headers: dict):
         """Write/delete gating for UNTOKENED frame connections, wired
-        once for the local-serve and sibling-forward paths: shapes the
-        frame transport must not serve (jwt-guarded clusters keep
-        their aiohttp semantics, multipart/replica framing, replicate
-        writes) answer None => FLAG_FALLBACK; a whitelist miss is a
-        hard 401. Returns True when the mutation may proceed."""
+        once for the local-serve and sibling-forward paths, mirroring
+        the aiohttp listener's _guarded_request policy: a connection
+        with cluster identity (worker token or verified jwt HELLO)
+        proceeds — wire.py's per-needle jwt checks still run; on a
+        jwt-secured cluster an identity-less mutation answers None =>
+        FLAG_FALLBACK (belt-and-braces: the HELLO refusal already
+        severed such connections); multipart framing stays
+        aiohttp-only; everything else — including replica fan-out
+        writes (x-raw-needle) and replicate-typed deletes, which the
+        HTTP listeners serve under the same whitelist — is gated on
+        -whiteList exactly like HTTP. A whitelist miss is a hard 401.
+        Returns True when the mutation may proceed."""
         vs = self.vs
-        if vs.jwt_key or query.get("type") == "replicate":
+        if self.authed:
+            return True
+        if vs.jwt_key:
             return None
-        if method in ("POST", "PUT"):
-            if headers.get("content-type", "").startswith(
-                    "multipart/") or \
-                    headers.get("x-raw-needle") == "1":
-                return None
+        if method in ("POST", "PUT") and headers.get(
+                "content-type", "").startswith("multipart/"):
+            return None
         if not vs.guard.empty and not vs.guard.allows(self.peer_ip):
             return wire.json_err(401, "ip not in whitelist")
         return True
@@ -361,7 +409,7 @@ class FrameServerProtocol(asyncio.Protocol):
     async def _send_fallback(self, req_id: int) -> None:
         from ..stats import metrics
         if metrics.HAVE_PROMETHEUS:
-            metrics.FRAME_FALLBACKS.inc()
+            metrics.FRAME_FALLBACKS.labels(self._hop_label()).inc()
         async with self._write_lock:
             if not self._closed:
                 self.transport.write(encode_frame(

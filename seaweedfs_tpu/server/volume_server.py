@@ -124,13 +124,16 @@ class VolumeServer:
         from ..util.frame import FrameHub
         self.frame_hub = FrameHub(
             token=worker_ctx.token if worker_ctx is not None else "",
-            ssl=tls.client_ctx())
+            ssl=tls.client_ctx(), jwt_key=jwt_key)
         self._sync_frames = SyncFramePool(
             timeout=30.0,
-            token=worker_ctx.token if worker_ctx is not None else "")
-        # targets that refused the frame handshake: monotonic deadline
-        # until which their shard fetches ride the HTTP pool
-        self._no_frame: dict[str, float] = {}
+            token=worker_ctx.token if worker_ctx is not None else "",
+            jwt_key=jwt_key)
+        # targets that refused the frame handshake: jittered-backoff
+        # re-probe gate (journals `frame_downgrade`), replacing the
+        # old sticky 60s HTTP downgrade
+        from ..util.connpool import FrameProbeGate
+        self._frame_gate = FrameProbeGate()
         self._frame_uds = ""
         self._frame_server = None
         # per-vid serialization for /admin/ec/rebuild_shard: an
@@ -545,25 +548,27 @@ class VolumeServer:
                           headers: dict) -> tuple[int, bytes]:
         """One /admin/ec/shard_read fetch (executor threads only):
         frame path first — tens of bytes of protocol overhead per
-        gather instead of HTTP headers — with a sticky per-target HTTP
-        downgrade when the holder refused the frame handshake
-        (predates the protocol), and a one-shot HTTP retry when the
-        frame transport failed mid-flight."""
+        gather instead of HTTP headers — with a jittered-backoff
+        re-probe gate when the holder refused the frame handshake
+        (predates the protocol; journaled as `frame_downgrade`), and a
+        one-shot HTTP retry when the frame transport failed
+        mid-flight."""
         from ..util.connpool import FrameUnsupported, PoolError
         path = "/admin/ec/shard_read"
         http_path = path + "?" + urllib.parse.urlencode(query)
-        now = time.monotonic()
-        if self._no_frame.get(target, 0.0) < now:
+        if self._frame_gate.allow(target):
             try:
-                return self._sync_frames.request(
+                # chaos site: injected inter-host EC gather frame
+                # faults take the exact ride-HTTP-this-request path a
+                # mid-flight transport failure does
+                failpoints.sync_fail("ec.fetch.frame")
+                out = self._sync_frames.request(
                     target, path, headers=headers, query=query)
+                self._frame_gate.ok(target)
+                return out
             except FrameUnsupported as e:
-                glog.V(1).infof("shard fetch %s: %s; HTTP for 60s",
-                                target, e)
-                if len(self._no_frame) > 256:
-                    self._no_frame.clear()
-                self._no_frame[target] = now + 60.0
-            except PoolError as e:
+                self._frame_gate.refused(target, str(e))
+            except (PoolError, OSError) as e:
                 # transport failure, not a protocol refusal: this
                 # request rides HTTP, the next one retries frames
                 glog.V(1).infof("shard fetch %s over frames: %s; "
@@ -691,6 +696,41 @@ class VolumeServer:
         self.store.new_ec_shards.extend(hb.new_ec_shards)
         self.store.deleted_ec_shards.extend(hb.deleted_ec_shards)
 
+    async def _frame_master_json(self, method: str, path: str,
+                                 query: dict | None = None,
+                                 payload: dict | None = None,
+                                 deadline: float = 10.0):
+        """One master control-plane request over the persistent frame
+        channel, parsed as JSON; None when the frame leg is
+        unavailable (peer predates frames, channel severed, breaker
+        open, non-JSON answer) so the caller rides HTTP. Failure here
+        never raises: the HTTP leg is the one whose errors drive
+        seed rotation / retry policy."""
+        try:
+            # chaos site: worker.frame (also armed inside the channel
+            # send itself) severs this control-plane frame leg so the
+            # HTTP fallback is exercised
+            await failpoints.fail("worker.frame")
+            chan = self.frame_hub.get(target=self.master_url)
+            status, _, raw = await chan.request(
+                method, path, query=query,
+                headers={"content-type": "application/json"}
+                if payload is not None else None,
+                body=json.dumps(payload).encode()
+                if payload is not None else b"",
+                timeout=deadline)
+            if status >= 500:
+                return None
+            return json.loads(raw)
+        except (asyncio.TimeoutError, OSError, ValueError):
+            return None
+
+    async def _frame_master_post(self, path: str, payload: dict,
+                                 deadline: float):
+        return await self._frame_master_json("POST", path,
+                                             payload=payload,
+                                             deadline=deadline)
+
     async def heartbeat_once(self) -> bool:
         """Returns True when the (leader) master accepted the state;
         False when a follower redirected us (deltas requeued, master_url
@@ -711,14 +751,29 @@ class VolumeServer:
                 # per-request timeout: a master that accepts the TCP
                 # connect but never answers must not wedge the pulse
                 # loop for the session default
-                async with self._http.post(
-                        tls.url(self.master_url, "/cluster/heartbeat"),
-                        json=hb.to_dict(),
-                        timeout=aiohttp.ClientTimeout(
-                            total=max(10.0, 4 * self.pulse_seconds),
-                            connect=5, sock_read=max(
-                                5.0, 2 * self.pulse_seconds))) as resp:
-                    body = await resp.json()
+                deadline = max(10.0, 4 * self.pulse_seconds)
+                body = await self._frame_master_post(
+                    "/cluster/heartbeat", hb.to_dict(), deadline)
+                if body is not None and body.get("rejected") \
+                        and body.get("leader") \
+                        and body["leader"] != self.master_url:
+                    # follower hint over frames: re-home and re-send
+                    # THIS pulse on the leader's channel, so frame
+                    # re-homing costs zero pulses exactly like the
+                    # HTTP path's auto-followed 307
+                    self.master_url = body["leader"]
+                    body = await self._frame_master_post(
+                        "/cluster/heartbeat", hb.to_dict(), deadline)
+                if body is None:
+                    async with self._http.post(
+                            tls.url(self.master_url,
+                                    "/cluster/heartbeat"),
+                            json=hb.to_dict(),
+                            timeout=aiohttp.ClientTimeout(
+                                total=deadline,
+                                connect=5, sock_read=max(
+                                    5.0, 2 * self.pulse_seconds))) as resp:
+                        body = await resp.json()
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
                 self._requeue_deltas(hb)
                 raise
@@ -1185,15 +1240,22 @@ class VolumeServer:
         """Fan out to the other replica locations
         (distributedOperation, store_replicate.go:140-155)."""
         vid = fid.split(",")[0]
-        try:
-            async with self._http.get(
-                    tls.url(self.master_url, "/dir/lookup"),
-                    params={"volumeId": vid}) as resp:
-                if resp.status != 200:
-                    return False
-                locs = (await resp.json())["locations"]
-        except aiohttp.ClientError:
-            return False
+        locs = None
+        body = await self._frame_master_json("GET", "/dir/lookup",
+                                             query={"volumeId": vid},
+                                             deadline=10.0)
+        if isinstance(body, dict):
+            locs = body.get("locations")
+        if locs is None:
+            try:
+                async with self._http.get(
+                        tls.url(self.master_url, "/dir/lookup"),
+                        params={"volumeId": vid}) as resp:
+                    if resp.status != 200:
+                        return False
+                    locs = (await resp.json())["locations"]
+            except aiohttp.ClientError:
+                return False
         targets = [l["url"] for l in locs if l["url"] != self.url]
 
         extra = {"Authorization": auth} if auth else {}
@@ -1205,6 +1267,42 @@ class VolumeServer:
         if rsp:
             tracing.inject(extra, rsp)
 
+        async def frame_one(target: str, body: bytes | None) -> bool | None:
+            """The fan-out hop over the persistent frame channel to
+            `target`; None means the frame leg is unavailable and the
+            caller rides HTTP (the channel breaker fails fast here, so
+            a severed peer costs microseconds, not a connect timeout).
+            The replica end enforces the same per-fid jwt and
+            -whiteList policy wire applies to the HTTP form."""
+            from ..util.frame import FrameChannelError
+            try:
+                # chaos site: forces the inter-host replication frame
+                # leg down so chaos/soak prove the fan-out stays
+                # correct on the HTTP fallback
+                await failpoints.fail("replication.frame")
+                chan = self.frame_hub.get(target=target)
+                if method == "POST":
+                    status, _, _b = await chan.request(
+                        "POST", f"/{fid}",
+                        query={"type": "replicate"},
+                        headers={"X-Raw-Needle": "1", **extra},
+                        body=body or b"", timeout=30.0)
+                    ok = status in (200, 201)
+                else:
+                    status, _, _b = await chan.request(
+                        "DELETE", f"/{fid}",
+                        query={"type": "replicate"},
+                        headers=extra, timeout=30.0)
+                    ok = status == 200
+                if not ok:
+                    glog.warning("replicate %s to %s (frame): "
+                                 "status %d", fid, target, status)
+                    rsp.event("replica_failed", target=target,
+                              status=status)
+                return ok
+            except (FrameChannelError, asyncio.TimeoutError, OSError):
+                return None     # severed/refused/breaker-open -> HTTP
+
         async def one(target: str) -> bool:
             try:
                 # chaos sites: `volume.replicate` injects transport
@@ -1214,9 +1312,14 @@ class VolumeServer:
                 # is then the only durable one — exactly the shape the
                 # degraded-read soak must survive)
                 await failpoints.fail("volume.replicate")
+                body = None
                 if method == "POST":
                     body = failpoints.corrupt("volume.replicate.body",
                                               raw_needle)
+                framed = await frame_one(target, body)
+                if framed is not None:
+                    return framed
+                if method == "POST":
                     async with self._http.post(
                             tls.url(target, f"/{fid}"),
                             params={"type": "replicate"},

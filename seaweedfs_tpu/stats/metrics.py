@@ -152,19 +152,27 @@ if HAVE_PROMETHEUS:
         "SeaweedFS_autopilot_paused",
         "1 while repair is parked behind a paging fleet",
         registry=REGISTRY)
-    # binary frame wire (util/frame.py): the intra-host sibling hop's
-    # request volume and its HTTP downgrades — a rising fallback rate
-    # means the frame path is being severed (chaos or a peer that
-    # predates the protocol)
+    # binary frame wire (util/frame.py): the frame fabric's request
+    # volume and its HTTP downgrades — a rising fallback rate means
+    # the frame path is being severed (chaos or a peer that predates
+    # the protocol). hop is low-cardinality by construction:
+    # sibling (intra-host worker hop) or interhost (the cluster fabric)
     FRAME_REQUESTS = Counter(
         "SeaweedFS_frame_requests_total",
-        "frame-RPC requests, by side (client = issued, server = served)",
-        ["side"], registry=REGISTRY)
+        "frame-RPC requests, by side (client = issued, server = "
+        "served) and hop (sibling = intra-host, interhost = fabric)",
+        ["side", "hop"], registry=REGISTRY)
     FRAME_FALLBACKS = Counter(
         "SeaweedFS_frame_fallbacks_total",
         "frame requests downgraded to the HTTP hop (server-advised "
-        "FLAG_FALLBACK answers + client-observed channel failures)",
-        registry=REGISTRY)
+        "FLAG_FALLBACK answers + client-observed channel failures), "
+        "by hop",
+        ["hop"], registry=REGISTRY)
+    FRAME_OPEN_CHANNELS = Gauge(
+        "SeaweedFS_frame_open_channels",
+        "currently-connected frame channels this process holds, per "
+        "peer target (bounded by FrameHub.MAX_CHANNELS)",
+        ["peer"], registry=REGISTRY)
     # build/restart detection (scrapes and timelines both need to tell
     # a counter reset apart from a rate dip): every daemon exports who
     # it is and when this process was born

@@ -20,6 +20,7 @@ from .resilience import BreakerRegistry, RetryBudget, RetryPolicy
 from .singleflight import SingleFlight
 
 import asyncio
+import json
 import time
 
 import aiohttp
@@ -171,6 +172,39 @@ class WeedClient:
                         continue
                     try:
                         await failpoints.fail("client.master_get")
+                        framed = await self._frame_json(
+                            self.master_url, "GET", path,
+                            params=params, headers=headers,
+                            timeout=30.0)
+                        if framed is not None:
+                            status, rh, body = framed
+                            if status in (307, 502, 503):
+                                # follower/no-leader answer: frames
+                                # carry the redirect as data (no
+                                # aiohttp auto-follow), so chase the
+                                # explicit leader hint ourselves
+                                last = body.get(
+                                    "error", f"frame {status}") \
+                                    if isinstance(body, dict) \
+                                    else f"frame {status}"
+                                br.record_success()
+                                hb = body if isinstance(body, dict) \
+                                    else {}
+                                hint = (hb.get("leader", "")
+                                        or rh.get("X-Raft-Leader", "")
+                                        or rh.get("x-raft-leader", ""))
+                                if hint and hint != self.master_url:
+                                    sp.event("leader_hint",
+                                             leader=hint)
+                                    self.master_url = hint
+                                else:
+                                    sp.event("seed_rotate",
+                                             status=status)
+                                    self._rotate_seed()
+                                continue
+                            br.record_success()
+                            sp.status = "ok"
+                            return body
                         async with self.http.get(
                                 tls.url(self.master_url, path),
                                 params=params, headers=headers,
@@ -343,24 +377,31 @@ class WeedClient:
                     break
                 try:
                     await failpoints.fail("client.upload")
-                    async with self.http.post(
-                            tls.url(url, f"/{fid}"), data=data,
-                            params=params, headers=headers,
-                            timeout=DATA_TIMEOUT) as resp:
-                        body = await resp.json()
-                        if resp.status in (200, 201):
-                            br.record_success()
-                            if self.chunk_cache is not None:
-                                self.chunk_cache.delete(fid)
-                            sp.status = "ok"
-                            sp.nbytes = len(data)
-                            return body
-                        if resp.status < 500:
-                            br.record_success()  # server healthy, we erred
-                            sp.status = str(resp.status)
-                            raise OperationError(f"upload {fid}: {body}")
-                        last = f"upload {fid}: {body}"
-                        br.record_failure()
+                    framed = await self._frame_json(
+                        url, "POST", f"/{fid}", params=params,
+                        headers=headers, body=data, timeout=60.0)
+                    if framed is not None:
+                        status, _, body = framed
+                    else:
+                        async with self.http.post(
+                                tls.url(url, f"/{fid}"), data=data,
+                                params=params, headers=headers,
+                                timeout=DATA_TIMEOUT) as resp:
+                            body = await resp.json()
+                            status = resp.status
+                    if status in (200, 201):
+                        br.record_success()
+                        if self.chunk_cache is not None:
+                            self.chunk_cache.delete(fid)
+                        sp.status = "ok"
+                        sp.nbytes = len(data)
+                        return body
+                    if status < 500:
+                        br.record_success()  # server healthy, we erred
+                        sp.status = str(status)
+                        raise OperationError(f"upload {fid}: {body}")
+                    last = f"upload {fid}: {body}"
+                    br.record_failure()
                 except (aiohttp.ClientError, asyncio.TimeoutError,
                         OSError, ValueError) as e:
                     last = f"upload {fid}: {type(e).__name__} {e}"
@@ -551,6 +592,39 @@ class WeedClient:
                         headers["Range"] = f"bytes={cur}-{end}"
                         if sent and tries > 1:
                             sp.event("range_resume", at=cur)
+                    else:
+                        # whole-needle fast path: one round trip on the
+                        # persistent frame channel to this holder.
+                        # Ranged and mid-body-resumed reads always ride
+                        # HTTP (Range is an HTTP-leg contract); any
+                        # frame failure or non-authoritative status
+                        # drops to the HTTP leg below, which keeps
+                        # owning breakers, rotation and retries
+                        from .frame import FrameChannelError
+                        status = None
+                        try:
+                            # chaos site: worker.frame severs this leg
+                            await failpoints.fail("worker.frame")
+                            chan = self.frame_hub.get(target=upstream)
+                            status, _, fbody = await chan.request(
+                                "GET", f"/{fid}", headers=headers,
+                                timeout=30.0)
+                        except (FrameChannelError,
+                                asyncio.TimeoutError, OSError):
+                            status = None
+                        if status in (404, 410):
+                            br.record_success()
+                            sp.status = "404"
+                            raise OperationError(
+                                f"read {fid}: not found")
+                        if status == 200:
+                            for pos in range(0, len(fbody), 1 << 16):
+                                chunk = fbody[pos:pos + (1 << 16)]
+                                sent += len(chunk)
+                                yield chunk
+                            br.record_success()
+                            sp.status = "ok"
+                            return
                     try:
                         await failpoints.fail("client.read")
                         async with self.http.get(
@@ -726,8 +800,35 @@ class WeedClient:
         pipelined against; closed with the session in __aexit__."""
         if self._frame_hub is None:
             from .frame import FrameHub
-            self._frame_hub = FrameHub(ssl=tls.client_ctx())
+            self._frame_hub = FrameHub(ssl=tls.client_ctx(),
+                                       jwt_key=self.jwt_key)
         return self._frame_hub
+
+    async def _frame_json(self, server: str, method: str, path: str,
+                          params: dict | None = None,
+                          headers: dict | None = None,
+                          body: bytes = b"",
+                          timeout: float = 30.0):
+        """One request over the persistent frame channel to `server`,
+        answer parsed as JSON: (status, headers, body-dict), or None
+        when the frame leg is unavailable (peer predates frames,
+        severed channel, open breaker, FLAG_FALLBACK) and the caller
+        should ride the resilient HTTP path. Never raises — HTTP is
+        the leg whose failures drive retry/breaker bookkeeping."""
+        from .frame import FrameChannelError
+        try:
+            # chaos site: worker.frame (also armed inside the channel
+            # send itself) severs this frame leg so every caller's
+            # HTTP fallback is exercised
+            await failpoints.fail("worker.frame")
+            chan = self.frame_hub.get(target=server)
+            status, rheaders, raw = await chan.request(
+                method, path, query=params, headers=headers,
+                body=body, timeout=timeout)
+            return status, rheaders, json.loads(raw or b"{}")
+        except (FrameChannelError, asyncio.TimeoutError, OSError,
+                ValueError):
+            return None
 
     async def pipelined_read(self, fids: list[str], depth: int = 8
                              ) -> dict[str, bytes | None]:
@@ -774,7 +875,7 @@ class WeedClient:
                     result[fid] = None
 
             async def one_server(server: str, group: list[str]) -> None:
-                ch = self.frame_hub.get(target=server)
+                chan = self.frame_hub.get(target=server)
                 sem = asyncio.Semaphore(max(1, depth))
                 fell_back = 0
 
@@ -785,8 +886,8 @@ class WeedClient:
                     async with sem:
                         try:
                             await failpoints.fail("client.pipeline")
-                            status, _, body = await ch.request(
-                                "GET", "/" + fid)
+                            status, _, body = await chan.request(
+                                "GET", "/" + fid, timeout=30.0)
                         except (FrameChannelError, OSError):
                             # dead channel / FLAG_FALLBACK / injected
                             # fault: this fid rides the HTTP path
@@ -865,25 +966,36 @@ class WeedClient:
                 payload["tokens"] = {f: self._mint_jwt(f) for f in batch}
             try:
                 await failpoints.fail("client.delete")
-                async with self.http.post(
-                        tls.url(server, "/admin/batch_delete"),
-                        json=payload, timeout=DATA_TIMEOUT) as resp:
-                    # the probe consumed by allow() MUST be resolved on
-                    # every path — an unrecorded outcome wedges the
-                    # breaker half-open forever
-                    br.record_success()   # reachable (any status)
-                    if resp.status == 200:
-                        res = (await resp.json()).get("results", [])
-                        ok = sum(r.get("status") in (200, 202)
-                                 for r in res)
-                        # rows the batch mode cannot handle (406 chunk
-                        # manifests, transient 5xx) still get the
-                        # per-fid tombstone the old path gave them
-                        retry = [r.get("fileId") for r in res
-                                 if r.get("status") in (406, 500, 503)]
-                        if retry:
-                            ok += await drop_one_by_one(server, retry)
-                        return ok
+                framed = await self._frame_json(
+                    server, "POST", "/admin/batch_delete",
+                    headers={"content-type": "application/json"},
+                    body=json.dumps(payload).encode(), timeout=30.0)
+                if framed is not None:
+                    br.record_success()
+                    status, _, jbody = framed
+                else:
+                    async with self.http.post(
+                            tls.url(server, "/admin/batch_delete"),
+                            json=payload, timeout=DATA_TIMEOUT) as resp:
+                        # the probe consumed by allow() MUST be
+                        # resolved on every path — an unrecorded
+                        # outcome wedges the breaker half-open forever
+                        br.record_success()   # reachable (any status)
+                        status = resp.status
+                        jbody = (await resp.json()
+                                 if status == 200 else {})
+                if status == 200:
+                    res = jbody.get("results", [])
+                    ok = sum(r.get("status") in (200, 202)
+                             for r in res)
+                    # rows the batch mode cannot handle (406 chunk
+                    # manifests, transient 5xx) still get the
+                    # per-fid tombstone the old path gave them
+                    retry = [r.get("fileId") for r in res
+                             if r.get("status") in (406, 500, 503)]
+                    if retry:
+                        ok += await drop_one_by_one(server, retry)
+                    return ok
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
                     ValueError):
                 br.record_failure()

@@ -23,14 +23,16 @@ sockets never surface to the caller):
 from __future__ import annotations
 
 import http.client
+import random
 import socket
 import threading
 import time
 
 from ..security import tls
-from . import glog
-from .frame import (FrameDecoder, FrameError, HELLO, HELLO_OK, MAGIC,
-                    REQ, RESP, VERSION, encode_frame)
+from . import events, glog
+from .frame import (FrameDecoder, FrameError, HELLO, HELLO_IDENTITY_FID,
+                    HELLO_IDENTITY_TTL_S, HELLO_OK, MAGIC, REQ, RESP,
+                    VERSION, encode_frame)
 
 
 class PoolError(OSError):
@@ -40,6 +42,58 @@ class PoolError(OSError):
 class FrameUnsupported(PoolError):
     """The target refused the frame handshake (predates the protocol
     or chaos severed it): retry this request over HTTP."""
+
+
+class FrameProbeGate:
+    """Per-target frame-downgrade bookkeeping with jittered
+    exponential backoff — the fix for the old sticky 60s HTTP
+    downgrade, where one transient peer restart silenced frames for a
+    full minute with no signal. Each refusal doubles the re-probe
+    delay (jittered +/-50% so a fleet of fetchers doesn't re-probe in
+    lockstep) up to ``cap_s``; a frame success resets the target.
+    Every downgrade is journaled as a ``frame_downgrade`` event.
+    Thread-safe: the EC gather calls this from executor threads."""
+
+    def __init__(self, base_s: float = 1.0, cap_s: float = 60.0,
+                 max_targets: int = 256, rng=None, clock=time.monotonic):
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.max_targets = max_targets
+        self._rng = rng if rng is not None else random.Random()
+        self._clock = clock
+        # target -> (monotonic re-probe time, consecutive refusals)
+        self._state: dict[str, tuple[float, int]] = {}
+        self._lock = threading.Lock()
+
+    def allow(self, target: str) -> bool:
+        """True when frames should be tried for this target (never
+        refused, or its backoff window has expired)."""
+        with self._lock:
+            st = self._state.get(target)
+            return st is None or self._clock() >= st[0]
+
+    def refused(self, target: str, reason: str = "") -> float:
+        """Record a frame refusal; returns the chosen re-probe delay
+        and journals the downgrade so it is never silent."""
+        with self._lock:
+            if target not in self._state \
+                    and len(self._state) >= self.max_targets:
+                self._state.clear()
+            strikes = self._state.get(target, (0.0, 0))[1] + 1
+            delay = min(self.cap_s,
+                        self.base_s * (2 ** min(strikes - 1, 16)))
+            delay = min(self.cap_s,
+                        delay * (0.5 + self._rng.random()))
+            self._state[target] = (self._clock() + delay, strikes)
+        events.record("frame_downgrade", target=target,
+                      retry_in_s=round(delay, 3), strikes=strikes,
+                      reason=reason[:160])
+        return delay
+
+    def ok(self, target: str) -> None:
+        """A frame request succeeded: clear the target's downgrade."""
+        with self._lock:
+            self._state.pop(target, None)
 
 
 class _IdlePool:
@@ -184,10 +238,12 @@ class SyncFramePool:
     the caller downgrades the TARGET to the HTTP pool."""
 
     def __init__(self, timeout: float = 30.0, per_target: int = 4,
-                 max_idle_s: float = 30.0, token: str = ""):
+                 max_idle_s: float = 30.0, token: str = "",
+                 jwt_key: str = ""):
         self._pool = _IdlePool(per_target, max_idle_s)
         self.timeout = timeout
         self.token = token
+        self.jwt_key = jwt_key          # mints the HELLO identity claim
 
     def _connect(self, target: str) -> _FrameConn:
         host, _, port = target.rpartition(":")
@@ -205,8 +261,13 @@ class SyncFramePool:
                 raise PoolError(f"frame tls {target}: {e}") from e
         conn = _FrameConn(sock)
         try:
-            sock.sendall(MAGIC + encode_frame(
-                HELLO, 0, {"v": VERSION, "token": self.token}))
+            hello_meta: dict = {"v": VERSION, "token": self.token}
+            if self.jwt_key:
+                from ..security.jwt import gen_jwt
+                hello_meta["id"] = gen_jwt(self.jwt_key,
+                                           HELLO_IDENTITY_FID,
+                                           HELLO_IDENTITY_TTL_S)
+            sock.sendall(MAGIC + encode_frame(HELLO, 0, hello_meta))
             fr = self._read_frame(conn)
             if fr.type != HELLO_OK:
                 raise FrameUnsupported(

@@ -67,6 +67,9 @@ TYPES = frozenset({
     "raft_step_down",           # a LEADER lost its standing (lease
                                 # expiry under partition, or a higher
                                 # term appeared) and stopped assigning
+    "frame_downgrade",          # a peer refused the frame handshake:
+                                # its requests ride HTTP until the
+                                # jittered re-probe window expires
 })
 
 _MAX_FIELDS = 16                # per-event field cap (bounded memory)

@@ -1,10 +1,13 @@
-"""Binary framed RPC for the intra-host data plane: ONE wire, no HTTP.
+"""Binary framed RPC for the cluster data fabric: ONE wire, no HTTP.
 
 The `-workers` sibling hop (and the client's pipelined multi-read)
 used to re-serialize a full HTTP request/response per needle through
 aiohttp — per-hop header parsing, header re-emission and one
-round-trip per request. This module replaces that hop with a compact
-length-prefixed frame spoken over persistent connections:
+round-trip per request. This module replaces that hop — and, since
+the frame-fabric PR, every inter-host hop (replication fan-out,
+client uploads/deletes, EC shard gather, master heartbeat/lookup,
+raft vote/append/snapshot) — with a compact length-prefixed frame
+spoken over persistent connections:
 
     u32  length      bytes after this field (= 12 + meta + payload)
     u8   type        HELLO / HELLO_OK / REQ / RESP / GOAWAY
@@ -15,14 +18,21 @@ length-prefixed frame spoken over persistent connections:
     payload bytes    raw body — never escaped, never chunked
 
 A connection opens with the ``MAGIC`` preamble (not a valid HTTP
-method, so the volume server's raw listener sniffs it and swaps the
-connection onto the frame protocol in place), then a HELLO frame
-carrying the worker launch token (empty for plain clients — reads are
-open exactly like the HTTP listeners; JWT write tokens ride in the
-request meta headers like any other header). Requests are
-MULTIPLEXED: many in-flight req_ids per connection, responses complete
-out of order, and a pipelining client keeps the socket full instead of
-paying a round trip per needle.
+method, so the volume server's raw listener — and the master's fast
+assign listener — sniffs it and swaps the connection onto the frame
+protocol in place), then a HELLO frame carrying the worker launch
+token (empty for plain clients — reads are open exactly like the HTTP
+listeners; JWT write tokens ride in the request meta headers like any
+other header) and, on jwt-secured clusters, a signed ``id`` claim
+minted from the cluster signing key: a HELLO presenting neither a
+valid worker token nor a valid identity is refused with GOAWAY before
+any payload is served. Requests are MULTIPLEXED: many in-flight
+req_ids per connection, responses complete out of order, and a
+pipelining client keeps the socket full instead of paying a round
+trip per needle. The in-flight window is congestion-aware: an AIMD
+controller fed by per-request round-trip times shrinks it when RTTs
+rise above the channel's observed floor (queue building at the peer)
+and grows it additively as responses drain.
 
 Server side terminates frames in server/frameserver.py — a thin
 adapter over server/wire.py exactly like the two HTTP listeners, so
@@ -32,19 +42,30 @@ Failure discipline: `worker.frame` failpoint at every request send;
 transport errors raise :class:`FrameChannelError` (an OSError) and the
 callers fall back to the HTTP hop, so a peer that predates the
 protocol — or a chaos run severing it — degrades to exactly the
-pre-frame behavior.
+pre-frame behavior. Each channel shares util/resilience.py's
+CircuitBreaker: repeated channel failures open the breaker so callers
+fail fast to HTTP, and the half-open probe re-tries frames instead of
+downgrading forever.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import struct
 
 from . import failpoints, glog
-from .resilience import Backoff
+from .resilience import Backoff, BreakerRegistry, CircuitBreaker
 
 MAGIC = b"SWFR1\n"
+
+# the fid-shaped claim a HELLO identity token is minted for
+# (security/jwt.py gen_jwt binds every token to a fid; the handshake's
+# "fid" is this constant, so a stolen per-needle write token can never
+# double as a channel identity)
+HELLO_IDENTITY_FID = "frame:hello"
+HELLO_IDENTITY_TTL_S = 30
 
 HELLO = 1
 HELLO_OK = 2
@@ -162,7 +183,7 @@ class ChannelStats:
 
     __slots__ = ("requests", "responses", "overhead_out", "overhead_in",
                  "payload_out", "payload_in", "connects", "writes",
-                 "reads", "fallbacks")
+                 "reads", "fallbacks", "window_shrinks", "window_grows")
 
     def __init__(self) -> None:
         self.requests = 0
@@ -175,6 +196,8 @@ class ChannelStats:
         self.writes = 0                # socket write calls
         self.reads = 0                 # socket read calls with data
         self.fallbacks = 0
+        self.window_shrinks = 0        # AIMD multiplicative decreases
+        self.window_grows = 0          # AIMD additive increases
 
     def to_dict(self) -> dict:
         return {k: getattr(self, k) for k in self.__slots__}
@@ -193,18 +216,34 @@ class FrameChannel:
     :class:`FrameChannelError` so callers hit their HTTP fallback in
     microseconds instead of a connect timeout. An idle connection
     (no traffic for ``idle_s``) is closed client-side and transparently
-    reopened by the next request."""
+    reopened by the next request.
+
+    The in-flight window is congestion-aware (AIMD): every completed
+    request feeds its round-trip time to :meth:`_observe_rtt`; RTTs
+    rising past twice the channel's observed floor shrink the window
+    multiplicatively, drained responses grow it additively. Callers
+    that pipeline harder than the window simply queue on the channel,
+    bounded by the request timeout."""
+
+    CWND_INIT = 8.0
+    CWND_MIN = 1
+    CWND_MAX = 64
 
     def __init__(self, target: str = "", uds_path: str = "",
                  token: str = "", connect_timeout: float = 5.0,
                  request_timeout: float = 30.0, idle_s: float = 60.0,
-                 ssl=None):
+                 ssl=None, jwt_key: str = "", hop: str = "",
+                 breaker: CircuitBreaker | None = None):
         if not target and not uds_path:
             raise ValueError("FrameChannel needs a tcp target or a "
                              "unix socket path")
         self.target = target            # "ip:port" (TCP fallback)
         self.uds_path = uds_path        # preferred intra-host transport
         self.token = token
+        self.jwt_key = jwt_key          # mints the HELLO identity claim
+        # sibling = intra-host worker hop, interhost = cluster fabric
+        self.hop = hop or ("sibling" if uds_path else "interhost")
+        self.breaker = breaker
         self.connect_timeout = connect_timeout
         self.request_timeout = request_timeout
         self.idle_s = idle_s
@@ -218,13 +257,76 @@ class FrameChannel:
         self._backoff = Backoff(base=0.05, cap=2.0)
         self._retry_at = 0.0            # monotonic fail-fast gate
         self._closed = False
+        self._cwnd = float(self.CWND_INIT)
+        self._rtt_best = float("inf")   # per-connection RTT floor
+        self._inflight = 0
+        self._win_waiters: collections.deque[asyncio.Future] = \
+            collections.deque()
+        self._gauge_open = False
 
     @property
     def connected(self) -> bool:
         return self._writer is not None
 
+    @property
+    def window(self) -> int:
+        """Current congestion window (max in-flight requests)."""
+        return max(self.CWND_MIN, int(self._cwnd))
+
     def _label(self) -> str:
         return self.uds_path or self.target
+
+    # ---- congestion window (AIMD) ----
+
+    def _observe_rtt(self, rtt: float) -> None:
+        """One completed request's round trip. RTT above 2x the
+        connection's floor means queueing at the peer: shrink the
+        window multiplicatively; otherwise grow it additively (classic
+        AIMD, deterministic given the sample sequence)."""
+        if rtt < self._rtt_best:
+            self._rtt_best = rtt
+        if rtt > self._rtt_best * 2 and self._cwnd > self.CWND_MIN:
+            self._cwnd = max(float(self.CWND_MIN), self._cwnd * 0.7)
+            self.stats.window_shrinks += 1
+        elif self._cwnd < self.CWND_MAX:
+            self._cwnd = min(float(self.CWND_MAX),
+                             self._cwnd + 1.0 / max(self._cwnd, 1.0))
+            self.stats.window_grows += 1
+        self._wake_waiters()
+
+    def _wake_waiters(self) -> None:
+        while self._win_waiters and self._inflight < self.window:
+            fut = self._win_waiters.popleft()
+            if not fut.done():
+                # reserve the slot for the woken waiter so a burst of
+                # releases cannot over-admit past the window
+                self._inflight += 1
+                fut.set_result(None)
+
+    async def _acquire_slot(self, timeout: float) -> None:
+        if self._inflight < self.window:
+            self._inflight += 1
+            return
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._win_waiters.append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError as e:
+            try:
+                self._win_waiters.remove(fut)
+            except ValueError:
+                # woken (slot reserved) in the same tick the timeout
+                # fired: give the slot back
+                if fut.done() and fut.exception() is None:
+                    self._release_slot()
+            raise FrameChannelError(
+                f"frame channel {self._label()}: congestion window "
+                f"wait timed out (window={self.window}, "
+                f"in flight={self._inflight})") from e
+
+    def _release_slot(self) -> None:
+        self._inflight -= 1
+        self._wake_waiters()
 
     async def _connect(self) -> None:
         loop = asyncio.get_running_loop()
@@ -244,8 +346,13 @@ class FrameChannel:
                     asyncio.open_connection(host, int(port),
                                             ssl=self._ssl),
                     self.connect_timeout)
-            writer.write(MAGIC + encode_frame(
-                HELLO, 0, {"v": VERSION, "token": self.token}))
+            hello_meta: dict = {"v": VERSION, "token": self.token}
+            if self.jwt_key:
+                from ..security.jwt import gen_jwt
+                hello_meta["id"] = gen_jwt(self.jwt_key,
+                                           HELLO_IDENTITY_FID,
+                                           HELLO_IDENTITY_TTL_S)
+            writer.write(MAGIC + encode_frame(HELLO, 0, hello_meta))
             await asyncio.wait_for(writer.drain(), self.connect_timeout)
             dec = FrameDecoder()
             hello: Frame | None = None
@@ -260,9 +367,12 @@ class FrameChannel:
                 if frames:
                     hello = frames[0]
             if hello.type != HELLO_OK:
+                why = str((hello.meta or {}).get("error", "")) \
+                    if hello.type == GOAWAY else ""
                 raise FrameChannelError(
                     f"frame channel {self._label()}: handshake "
-                    f"refused (type {hello.type})")
+                    f"refused (type {hello.type}"
+                    + (f": {why}" if why else "") + ")")
         except (OSError, asyncio.TimeoutError, FrameError,
                 asyncio.IncompleteReadError) as e:
             # the just-opened socket must not leak on a failed
@@ -281,6 +391,11 @@ class FrameChannel:
         self._retry_at = 0.0
         self._writer = writer
         self.stats.connects += 1
+        self._rtt_best = float("inf")  # fresh RTT floor per connection
+        from ..stats import metrics
+        if metrics.HAVE_PROMETHEUS and not self._gauge_open:
+            metrics.FRAME_OPEN_CHANNELS.labels(self._label()).inc()
+            self._gauge_open = True
         self._reader_task = loop.create_task(
             self._read_loop(reader, writer, dec))
         # frames the peer pipelined behind HELLO_OK in the same chunk
@@ -348,6 +463,12 @@ class FrameChannel:
         if self._writer is writer:
             self._writer = None
             self._reader_task = None
+            if self._gauge_open:
+                from ..stats import metrics
+                if metrics.HAVE_PROMETHEUS:
+                    metrics.FRAME_OPEN_CHANNELS.labels(
+                        self._label()).dec()
+                self._gauge_open = False
         try:
             writer.close()
         except OSError:
@@ -376,17 +497,36 @@ class FrameChannel:
         SeaweedFS_frame_fallbacks_total — the severed-wire alert
         signal (FLAG_FALLBACK answers are counted by the SERVER that
         sent them, so one logical downgrade never counts twice on a
-        merged host)."""
+        merged host). An open circuit breaker (repeated channel
+        failures) fails fast here without touching the socket; its
+        half-open window admits a probe so frames resume on their own
+        once the peer heals."""
+        br = self.breaker
+        if br is not None and not br.allow():
+            from ..stats import metrics
+            if metrics.HAVE_PROMETHEUS:
+                metrics.FRAME_FALLBACKS.labels(self.hop).inc()
+            raise FrameChannelError(
+                f"frame channel {self._label()}: circuit open")
         try:
-            return await self._request(method, path, query, headers,
-                                       body, timeout)
+            out = await self._request(method, path, query, headers,
+                                      body, timeout)
         except FrameFallback:
-            raise                      # server-advised: peer counted it
+            # server-advised downgrade: the peer is alive and counted
+            # it — not a channel failure, the breaker stays closed
+            if br is not None:
+                br.record_success()
+            raise
         except FrameChannelError:
             from ..stats import metrics
             if metrics.HAVE_PROMETHEUS:
-                metrics.FRAME_FALLBACKS.inc()
+                metrics.FRAME_FALLBACKS.labels(self.hop).inc()
+            if br is not None:
+                br.record_failure()
             raise
+        if br is not None:
+            br.record_success()
+        return out
 
     async def _request(self, method: str, path: str,
                        query: dict | None, headers: dict | None,
@@ -404,52 +544,60 @@ class FrameChannel:
         except OSError as e:
             raise FrameChannelError(
                 f"frame channel {self._label()}: {e}") from e
-        if self._writer is None:
-            async with self._conn_lock:
-                if self._writer is None and not self._closed:
-                    await self._connect()
-        writer = self._writer
-        if writer is None:
-            raise FrameChannelError(
-                f"frame channel {self._label()}: not connected")
-        req_id = self._next_id
-        self._next_id = (self._next_id + 1) & 0xFFFFFFFF or 1
-        meta: dict = {"m": method, "p": path}
-        if query:
-            meta["q"] = query
-        if headers:
-            meta["h"] = headers
-        # encode BEFORE registering the future: an oversize-meta
-        # FrameError must not leak a pending entry (which would flip
-        # the reader loop onto the response timeout forever)
-        frame = encode_frame(REQ, req_id, meta, body)
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[req_id] = fut
-        self.stats.requests += 1
-        from ..stats import metrics
-        if metrics.HAVE_PROMETHEUS:
-            metrics.FRAME_REQUESTS.labels("client").inc()
-        self.stats.overhead_out += len(frame) - len(body)
-        self.stats.payload_out += len(body)
-        self.stats.writes += 1
+        deadline = timeout if timeout is not None else \
+            self.request_timeout
+        await self._acquire_slot(deadline)
         try:
-            writer.write(frame)
-            await writer.drain()
-            status, hdrs, payload, _ = await asyncio.wait_for(
-                fut, timeout if timeout is not None
-                else self.request_timeout)
-            return status, hdrs, payload
-        except asyncio.TimeoutError as e:
-            self._pending.pop(req_id, None)
-            raise FrameChannelError(
-                f"frame channel {self._label()}: request timeout") \
-                from e
-        except (OSError, ConnectionResetError) as e:
-            self._pending.pop(req_id, None)
-            if isinstance(e, FrameChannelError):
-                raise
-            raise FrameChannelError(
-                f"frame channel {self._label()}: {e}") from e
+            if self._writer is None:
+                async with self._conn_lock:
+                    if self._writer is None and not self._closed:
+                        await self._connect()
+            writer = self._writer
+            if writer is None:
+                raise FrameChannelError(
+                    f"frame channel {self._label()}: not connected")
+            req_id = self._next_id
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF or 1
+            meta: dict = {"m": method, "p": path}
+            if query:
+                meta["q"] = query
+            if headers:
+                meta["h"] = headers
+            # encode BEFORE registering the future: an oversize-meta
+            # FrameError must not leak a pending entry (which would
+            # flip the reader loop onto the response timeout forever)
+            frame = encode_frame(REQ, req_id, meta, body)
+            loop = asyncio.get_running_loop()
+            fut: asyncio.Future = loop.create_future()
+            self._pending[req_id] = fut
+            self.stats.requests += 1
+            from ..stats import metrics
+            if metrics.HAVE_PROMETHEUS:
+                metrics.FRAME_REQUESTS.labels("client", self.hop).inc()
+            self.stats.overhead_out += len(frame) - len(body)
+            self.stats.payload_out += len(body)
+            self.stats.writes += 1
+            t0 = loop.time()
+            try:
+                writer.write(frame)
+                await writer.drain()
+                status, hdrs, payload, _ = await asyncio.wait_for(
+                    fut, deadline)
+                self._observe_rtt(loop.time() - t0)
+                return status, hdrs, payload
+            except asyncio.TimeoutError as e:
+                self._pending.pop(req_id, None)
+                raise FrameChannelError(
+                    f"frame channel {self._label()}: request timeout") \
+                    from e
+            except (OSError, ConnectionResetError) as e:
+                self._pending.pop(req_id, None)
+                if isinstance(e, FrameChannelError):
+                    raise
+                raise FrameChannelError(
+                    f"frame channel {self._label()}: {e}") from e
+        finally:
+            self._release_slot()
 
     async def close(self) -> None:
         self._closed = True
@@ -477,13 +625,22 @@ class FrameHub:
     MAX_CHANNELS = 64
 
     def __init__(self, token: str = "", request_timeout: float = 30.0,
-                 ssl=None):
+                 ssl=None, jwt_key: str = "",
+                 breakers: BreakerRegistry | None = None):
         self.token = token
+        self.jwt_key = jwt_key
         self.request_timeout = request_timeout
         self._ssl = ssl
+        # repeated channel failures open the per-peer breaker: callers
+        # fail fast to HTTP, the half-open probe re-tries frames
+        # (threshold/reset sized to match the connect Backoff cap)
+        self.breakers = breakers if breakers is not None else \
+            BreakerRegistry(threshold=5, reset_timeout=2.0,
+                            half_open_max=2)
         self._channels: dict[str, FrameChannel] = {}
 
-    def get(self, target: str = "", uds_path: str = "") -> FrameChannel:
+    def get(self, target: str = "", uds_path: str = "",
+            hop: str = "") -> FrameChannel:
         key = uds_path or target
         ch = self._channels.get(key)
         if ch is None:
@@ -493,7 +650,9 @@ class FrameHub:
                 _close_soon(old)
             ch = self._channels[key] = FrameChannel(
                 target=target, uds_path=uds_path, token=self.token,
-                request_timeout=self.request_timeout, ssl=self._ssl)
+                request_timeout=self.request_timeout, ssl=self._ssl,
+                jwt_key=self.jwt_key, hop=hop,
+                breaker=self.breakers.get(f"frame:{key}"))
         return ch
 
     def stats_dict(self) -> dict:

@@ -697,9 +697,11 @@ def test_weedclient_pipelined_read(tmp_path):
                 assert got[missing] is None
                 for fid, body_ in fids.items():
                     assert got[fid] == body_
-                # all needles rode ONE multiplexed connection
-                stats = list(
-                    wc.frame_hub.stats_dict().values())[0]
+                # all needles rode ONE multiplexed connection (the
+                # hub also holds a master channel now that lookups
+                # ride frames — pick the busy data channel)
+                stats = max(wc.frame_hub.stats_dict().values(),
+                            key=lambda s: s["requests"])
                 assert stats["connects"] == 1
                 assert stats["requests"] == len(ask)
                 assert stats["fallbacks"] == 0
@@ -730,10 +732,11 @@ def test_weedclient_pipelined_read_falls_back_on_channel_fault(tmp_path):
 # ------------------------------------------------- review hardening
 
 def test_sibling_forward_gates_external_mutations(tmp_path):
-    """The sibling frame channel carries the launch token, so an
-    UNTOKENED client's write/delete for a sibling-owned vid must be
-    gated BEFORE forwarding — a jwt-guarded cluster answers
-    FLAG_FALLBACK (HTTP owns the 401), never a laundered 201."""
+    """On a jwt-secured cluster an identity-less frame HELLO is
+    refused outright (GOAWAY before any payload is served): an
+    untokened client never reaches the token-marked sibling forward
+    at all, and a properly-identified channel confirms the needle was
+    genuinely never written."""
     async def body():
         from seaweedfs_tpu.server.volume_server import VolumeServer
         from seaweedfs_tpu.server.workers import WorkerContext
@@ -768,17 +771,26 @@ def test_sibling_forward_gates_external_mutations(tmp_path):
                     target=f"127.0.0.1:{workers[0].port}")
                 try:
                     # write AND delete for the sibling-owned vid: the
-                    # jwt gate fires BEFORE the token-marked forward
-                    with pytest.raises(FrameFallback):
+                    # identity-less HELLO is refused before any
+                    # payload is served
+                    with pytest.raises(FrameChannelError,
+                                       match="handshake refused"):
                         await ch.request("POST", "/" + fid,
                                          body=b"laundered?")
-                    with pytest.raises(FrameFallback):
+                    with pytest.raises(FrameChannelError):
                         await ch.request("DELETE", "/" + fid)
-                    # and the needle was genuinely never written
-                    st, _, _ = await ch.request("GET", "/" + fid)
-                    assert st == 404
                 finally:
                     await ch.close()
+                # the needle was genuinely never written: ask over a
+                # channel carrying a verifiable jwt identity
+                ch2 = FrameChannel(
+                    target=f"127.0.0.1:{workers[0].port}",
+                    jwt_key="secret")
+                try:
+                    st, _, _ = await ch2.request("GET", "/" + fid)
+                    assert st == 404
+                finally:
+                    await ch2.close()
             finally:
                 for vs in workers:
                     await vs.stop()

@@ -379,6 +379,58 @@ def test_failpoint_site_covers_master_control_plane(tmp_path):
     assert found == []
 
 
+def test_failpoint_site_covers_frame_fabric(tmp_path):
+    """The frame fabric (util/frame.py, util/connpool.py) is in
+    failpoint scope, and a frame-channel receiver (`chan`/`channel`)
+    counts as an outbound session: a multiplexed request send without
+    a chaos site in reach is a hop the soak can never sever."""
+    found = probs(tmp_path, """
+        async def fanout(self, target, fid, body):
+            chan = self.frame_hub.get(target=target)
+            return await chan.request("POST", "/" + fid, body=body,
+                                      timeout=30.0)
+    """, name="seaweedfs_tpu/util/frame.py",
+        select=["failpoint-site"])
+    assert rule_ids(found) == ["failpoint-site"]
+    found = probs(tmp_path, """
+        from seaweedfs_tpu.util import failpoints
+        async def fanout(self, target, fid, body):
+            await failpoints.fail("replication.frame")
+            chan = self.frame_hub.get(target=target)
+            return await chan.request("POST", "/" + fid, body=body,
+                                      timeout=30.0)
+    """, name="seaweedfs_tpu/util/connpool.py",
+        select=["failpoint-site"])
+    assert found == []
+
+
+def test_timeout_discipline_covers_frame_channels(tmp_path):
+    """A frame-channel request with no timeout in reach is a wedged
+    caller waiting on a wedged peer — `chan`/`channel` receivers are
+    held to the same discipline as aiohttp sessions (phase-2 rule, so
+    the fixture runs through run_paths)."""
+    mod = tmp_path / "seaweedfs_tpu" / "util" / "newhop.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(textwrap.dedent("""
+        async def read_one(self, fid):
+            chan = self.frame_hub.get(target="x")
+            return await chan.request("GET", "/" + fid)
+    """))
+    found = [f for f in run_paths(
+        [str(mod)], make_rules(select=["timeout-discipline"]))
+        if not f.suppressed]
+    assert rule_ids(found) == ["timeout-discipline"]
+    mod.write_text(textwrap.dedent("""
+        async def read_one(self, fid):
+            chan = self.frame_hub.get(target="x")
+            return await chan.request("GET", "/" + fid, timeout=30.0)
+    """))
+    found = [f for f in run_paths(
+        [str(mod)], make_rules(select=["timeout-discipline"]))
+        if not f.suppressed]
+    assert found == []
+
+
 def test_executor_ctx_fires_on_raw_run_in_executor(tmp_path):
     found = probs(tmp_path, """
         import asyncio

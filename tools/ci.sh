@@ -77,6 +77,15 @@ if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/recorder_smoke.py; the
     exit 1
 fi
 
+echo "== frame fabric smoke (inter-host frames + HTTP fallback + HELLO auth) =="
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/fabric_smoke.py; then
+    echo "fabric smoke: FAILED (inter-host frame fabric regression —"
+    echo "replica fan-out must ride frames byte-identically, survive a"
+    echo "severed frame leg over HTTP, and a jwt-secured master must"
+    echo "refuse unauthenticated HELLOs; see output above)"
+    exit 1
+fi
+
 echo "== ec smoke (repair bandwidth + stripe-batch engine + bake-off) =="
 if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/bench_ec.py --smoke; then
     echo "bench_ec smoke: FAILED (EC regression — minimal-fetch must"

@@ -199,6 +199,29 @@ def main() -> int:
         print(f"  raft: is_leader gauge + leader_change journal OK "
               f"(term {int(mg['SeaweedFS_raft_term'])})")
 
+        # -- frame fabric (hop-labeled wire accounting schema) ----------
+        vfr = [k for w in tl["windows"] for k in w["rates"]
+               if k.startswith("SeaweedFS_frame_requests_total")]
+        check(vfr, "no frame_requests_total counters on the volume")
+        check(any('hop="interhost"' in k and 'side="client"' in k
+                  for k in vfr),
+              f"volume->master heartbeat not counted as a "
+              f"client/interhost frame hop (saw {sorted(set(vfr))})")
+        vgauges: dict = {}
+        for w in tl["windows"]:
+            vgauges.update(w["gauges"])
+        open_ch = [k for k in vgauges
+                   if k.startswith("SeaweedFS_frame_open_channels")]
+        check(any(f'peer="{master}"' in k for k in open_ch),
+              f"no per-peer open-channel gauge for the master "
+              f"(saw {open_ch})")
+        mfr = [k for w in mtl["windows"] for k in w["rates"]
+               if k.startswith("SeaweedFS_frame_requests_total")]
+        check(any('side="server"' in k for k in mfr),
+              f"master served no frame requests (saw {sorted(set(mfr))})")
+        print(f"  frames: hop-labeled request counters + "
+              f"{len(open_ch)} open-channel gauge(s) OK")
+
         # -- /debug/autopilot (forced dry-run cycle) --------------------
         ap = get_json(master, "/debug/autopilot")["autopilot"]
         for key in ("enabled", "leader", "dryrun", "state", "cycles",

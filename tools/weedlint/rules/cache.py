@@ -28,7 +28,7 @@ _EVIDENCE = ("cache", "invalid", "drop", "gen_fence", "bump_gen")
 
 _HTTP_VERBS = {"get", "post", "put", "delete", "head", "patch",
                "request"}
-_SESSIONISH = re.compile(r"(?i)(sess|session|http|client)$")
+_SESSIONISH = re.compile(r"(?i)(sess|session|http|client|chan|channel)$")
 # repo-relative path fragments where the failpoint discipline applies
 # (the data plane the chaos soak drives)
 FAILPOINT_SCOPE = ("seaweedfs_tpu/server/", "seaweedfs_tpu/replication/",
@@ -50,7 +50,12 @@ FAILPOINT_SCOPE = ("seaweedfs_tpu/server/", "seaweedfs_tpu/replication/",
                    # grow/delete fan-outs and the etcd id reservation
                    # must sit within chaos-site reach — tools/chaos.py
                    # ha partitions the quorum through them
-                   "seaweedfs_tpu/master/")
+                   "seaweedfs_tpu/master/",
+                   # the frame fabric itself: every multiplexed request
+                   # send (worker.frame) and the sync frame pool the EC
+                   # gather rides must stay chaos-reachable
+                   "seaweedfs_tpu/util/frame.py",
+                   "seaweedfs_tpu/util/connpool.py")
 
 
 def _mentions_evidence(fn: ast.AST, spec: re.Pattern) -> bool:

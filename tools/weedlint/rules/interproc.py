@@ -297,7 +297,8 @@ def _reaches(adj: dict[str, set[str]], src: str, dst: str) -> bool:
     return False
 
 
-_SESSIONISH = re.compile(r"(?i)(sess|session|http|client|pool)$")
+_SESSIONISH = re.compile(r"(?i)(sess|session|http|client|pool|chan"
+                         r"|channel)$")
 _TIMEOUT_NAME = re.compile(r"(?i)(timeout|deadline)")
 TIMEOUT_SCOPE = ("seaweedfs_tpu/",)
 
