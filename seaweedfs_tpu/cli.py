@@ -67,7 +67,40 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    help="declarative latency objective evaluated over "
                         "the timeline with fast/slow burn-rate windows "
                         "and served at /debug/health, e.g. "
-                        "'volume.read:p99<50ms@99.9' (repeatable)")
+                        "'volume.read:p99<50ms@99.9' or per-tenant "
+                        "'s3.get/paying:p99<200ms@99' (repeatable)")
+    p.add_argument("-qos.tenant", dest="qos_tenant", action="append",
+                   default=[],
+                   help="tenant QoS class 'key:weight:rps[:burst]' — "
+                        "key is the SigV4 access key / JWT sub (or "
+                        "'default' for unclassified traffic), weight "
+                        "sets the weighted-fair share and shed "
+                        "priority, rps the token-bucket rate (0 = "
+                        "unlimited); repeatable, arms per-tenant "
+                        "admission on the s3/filer/webdav tiers and "
+                        "/debug/qos")
+    p.add_argument("-qos.shed.lagms", dest="qos_shed_lagms",
+                   type=float, default=0.0,
+                   help="arm priority load shedding when the sampled "
+                        "event-loop lag crosses this many ms (lowest "
+                        "weight class shed first; 0 disables)")
+    p.add_argument("-qos.shed.waitms", dest="qos_shed_waitms",
+                   type=float, default=0.0,
+                   help="arm shedding on executor queue wait above "
+                        "this many ms (same ladder as -qos.shed.lagms)")
+    p.add_argument("-qos.mbps", dest="qos_mbps", type=float,
+                   default=0.0,
+                   help="cluster foreground byte budget in MiB/s for "
+                        "the bandwidth arbiter: background consumers "
+                        "(scrub, autopilot) yield toward -qos.floor as "
+                        "foreground traffic approaches it; the leader "
+                        "master publishes it to volume nodes through "
+                        "heartbeats (0 disables arbitration)")
+    p.add_argument("-qos.floor", dest="qos_floor", type=float,
+                   default=0.25,
+                   help="starvation-proof fraction of a background "
+                        "consumer's base rate the arbiter always "
+                        "grants, whatever the foreground pressure")
 
 
 def _add_workers(p: argparse.ArgumentParser) -> None:
@@ -318,10 +351,12 @@ def build_parser() -> argparse.ArgumentParser:
     s3p.add_argument("-port", type=int, default=8333)
     s3p.add_argument("-store", default="sqlite")
     s3p.add_argument("-dbPath", default="./s3filer.db")
-    s3p.add_argument("-accessKey", default="",
+    s3p.add_argument("-accessKey", action="append", default=[],
                      help="require SigV4 auth with this access key "
-                          "(empty = anonymous)")
-    s3p.add_argument("-secretKey", default="")
+                          "(repeatable — pair each with a -secretKey "
+                          "in the same order for multi-tenant "
+                          "credentials; empty = anonymous)")
+    s3p.add_argument("-secretKey", action="append", default=[])
     s3p.add_argument("-domainName", default="",
                      help="enable virtual-host-style requests "
                           "(Host: bucket.<domainName>)")
@@ -1026,7 +1061,10 @@ async def _run_s3(args) -> None:
     from .filer.filer import Filer
     from .s3.gateway import S3Gateway
     kwargs = _store_kwargs(args.store, args.dbPath)
-    identities = ({args.accessKey: args.secretKey}
+    if len(args.accessKey) != len(args.secretKey):
+        raise SystemExit("-accessKey and -secretKey must be paired "
+                         "(one -secretKey per -accessKey, same order)")
+    identities = (dict(zip(args.accessKey, args.secretKey))
                   if args.accessKey else None)
     filer = Filer(args.store, **kwargs)
     await tracing.run_in_executor(_attach_discovered_queue, filer)
@@ -1904,6 +1942,19 @@ def main(argv: list[str] | None = None) -> None:
             # refuse to start guarding nothing: a typo'd objective
             # silently ignored would "pass" every soak
             raise SystemExit(str(e))
+        from . import qos
+        try:
+            if args.qos_tenant:
+                qos.init_admission(args.qos_tenant,
+                                   lag_shed_ms=args.qos_shed_lagms,
+                                   wait_shed_ms=args.qos_shed_waitms)
+        except ValueError as e:
+            # same refusal discipline as a typo'd -slo: a malformed
+            # tenant spec silently dropped would leave that tenant in
+            # the default class and "pass" every abuse soak
+            raise SystemExit(str(e))
+        if args.qos_mbps > 0:
+            qos.init_arbiter(args.qos_mbps, floor=args.qos_floor)
         if args.slo and not timeline.enabled():
             # same hazard as a typo'd spec: with the recorder off no
             # window is ever snapped, slo.tick() never runs, and

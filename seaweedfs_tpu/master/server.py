@@ -17,6 +17,7 @@ import time
 from aiohttp import web
 import aiohttp
 
+from .. import qos
 from ..pb import messages as pb
 from ..storage import types as t
 from ..storage.super_block import ReplicaPlacement
@@ -120,6 +121,13 @@ class MasterServer:
             concurrency=autopilot_concurrency,
             tier_backend=autopilot_tier_backend,
             garbage_threshold=garbage_threshold)
+        # bandwidth arbiter adoption (-qos.mbps): autopilot repair
+        # pacing yields to cluster foreground pressure (volume nodes
+        # report theirs through heartbeats) down to the floor
+        arb = qos.arbiter()
+        if arb is not None:
+            self.autopilot.executor.bucket = arb.adopt(
+                "autopilot", self.autopilot.executor.bucket)
         self.app = self._build_app()
 
     # ------------------------------------------------------------------
@@ -198,6 +206,7 @@ class MasterServer:
         app.router.add_get("/debug/events", h_ev)
         app.router.add_get("/debug/health", h_hl)
         app.router.add_route("*", "/debug/autopilot", self.h_autopilot)
+        app.router.add_get("/debug/qos", qos.debug_handler)
         app.router.add_route("*", "/vol/grow", self.h_grow)
         app.router.add_route("*", "/vol/vacuum", self.h_vacuum)
         app.router.add_route("*", "/col/delete", self.h_collection_delete)
@@ -525,7 +534,8 @@ class MasterServer:
         if metrics.HAVE_PROMETHEUS:
             metrics.MASTER_RECEIVED_HEARTBEATS.inc()
         try:
-            hb = pb.Heartbeat.from_dict(await req.json())
+            raw = await req.json()
+            hb = pb.Heartbeat.from_dict(raw)
         except (ValueError, TypeError, KeyError, AttributeError):
             return web.json_response({"error": "bad heartbeat body"},
                                      status=400)
@@ -554,10 +564,21 @@ class MasterServer:
                 "deleted_vids": sorted({m.id for m in hb.deleted_volumes}
                                        | {m.id for m in hb.deleted_ec_shards}),
             })
-        return web.json_response({
+        out = {
             "volume_size_limit": self.volume_size_limit,
             "leader": self.url,
-        })
+        }
+        # cluster-wide bandwidth arbitration rides the pulse: the node
+        # reports its foreground byte rate, the leader publishes the
+        # -qos.mbps budget every arbiter in the fleet paces against
+        arb = qos.arbiter()
+        if arb is not None:
+            fg = raw.get("qos_fg_bps")
+            if isinstance(fg, (int, float)):
+                arb.note_node_foreground(node.url, float(fg))
+            if arb.budget_bps > 0:
+                out["qos_mbps"] = round(arb.budget_bps / (1 << 20), 3)
+        return web.json_response(out)
 
     async def h_seq_lease(self, req: web.Request) -> web.Response:
         """Lease a block of file ids to an assign accelerator

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import math
 import time
 import urllib.parse
 import uuid
@@ -51,6 +52,18 @@ def _err(code: str, message: str, status: int) -> web.Response:
         content_type="application/xml", status=status)
 
 
+def _shed_response(dec) -> web.Response:
+    """AWS-shaped throttle answer: the `SlowDown` error XML aws-sdk
+    clients back off on natively, with `Retry-After` derived from the
+    tenant's own bucket refill (integer delta-seconds, rounded up so
+    a sub-second refill never reads as 'retry immediately')."""
+    resp = _err("SlowDown", "Please reduce your request rate.",
+                dec.status)
+    resp.headers["Retry-After"] = \
+        str(max(1, math.ceil(dec.retry_after_s)))
+    return resp
+
+
 def _ts(t: float) -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(t))
 
@@ -68,7 +81,8 @@ class S3Gateway:
                  identities: dict[str, str] | None = None,
                  domain_name: str = "",
                  cache_mem_bytes: int = 0,
-                 cache_dir: str = ""):
+                 cache_dir: str = "",
+                 admission=None):
         # -cache.mem/-cache.dir chunk read cache (see FilerServer)
         self.cache_mem_bytes = cache_mem_bytes
         self.cache_dir = cache_dir
@@ -84,9 +98,17 @@ class S3Gateway:
         # (s3api_auth.go authTypeAnonymous when no identities configured)
         self.identities = dict(identities or {})
         self._verifier = SigV4Verifier(self.identities)
+        # explicit AdmissionController for tests; daemons leave None
+        # and the middleware consults the process singleton live
+        self.admission = admission
         self.client: WeedClient | None = None
         self._runner: web.AppRunner | None = None
         self.app = self._build_app()
+
+    def _admission(self):
+        from .. import qos
+        return self.admission if self.admission is not None \
+            else qos.admission()
 
     def _build_app(self) -> web.Application:
         from ..util import tracing
@@ -106,6 +128,13 @@ class S3Gateway:
         app.router.add_post("/__debug__/timeline", h_tl)
         app.router.add_get("/__debug__/events", h_ev)
         app.router.add_get("/__debug__/health", h_hl)
+        from .. import qos
+        app.router.add_get("/__debug__/qos", qos.debug_handler)
+        # the qos soak arms/disarms `qos.admit` here at runtime, the
+        # same shared admin surface the volume/master/filer expose
+        from ..util import failpoints
+        app.router.add_route("*", "/__debug__/failpoints",
+                             failpoints.handle_debug)
         # "*": with -domainName, PUT/DELETE bucket.domain/ are bucket
         # operations that land on the root path
         app.router.add_route("*", "/", self.h_list_buckets)
@@ -115,8 +144,13 @@ class S3Gateway:
 
     @web.middleware
     async def _auth_middleware(self, req: web.Request, handler):
+        from .. import qos
         from ..util import tracing
-        if self.identities:
+        # the reserved introspection paths are NOT S3 objects and are
+        # served unsigned, exactly like every other tier's /debug
+        # surface (the bucket-shadowing caveat above already applies)
+        debug = req.path.startswith("/__debug__")
+        if self.identities and not debug:
             try:
                 # raw_path: SigV4 signs the encoded form verbatim, and a
                 # decode-requote round trip corrupts keys like a%2Fb;
@@ -126,21 +160,44 @@ class S3Gateway:
                     list(req.query.items()), req.headers, None)
             except AuthError as e:
                 return _err(e.code, str(e), _auth_status(e))
-        sp = (tracing._NOOP if req.path.startswith("/__debug__")
+        op = req.method.lower()
+        # tenant admission AFTER auth (the identity is the verified
+        # access key — an unsigned scan can't impersonate a class) and
+        # BEFORE the handler: a shed request costs no filer/volume work
+        ctrl = None if debug else self._admission()
+        dec = None
+        if ctrl is not None:
+            ctx = req.get("s3auth")
+            # weedlint: ignore[lock-acquire] admission decision, not a mutex: a denied Decision holds nothing, and the admitted path releases in the finally below
+            dec = await ctrl.acquire(
+                "s3", op, getattr(ctx, "access_key", "") if ctx else "")
+            if not dec.admitted:
+                return _shed_response(dec)
+            qos.set_current_class(dec.cls)
+        sp = (tracing._NOOP if debug
               else tracing.start_root(
-                  "s3", req.method.lower(), headers=req.headers))
-        with sp:
-            try:
-                resp = await handler(req)
-            except AuthError as e:
-                # mid-stream chunk-signature / truncation failures
-                sp.status = "auth"
-                return _err(e.code, str(e), _auth_status(e))
-            except web.HTTPException as e:
-                sp.status = str(e.status)
-                raise
-            sp.status = "ok" if resp.status < 400 else str(resp.status)
-            return resp
+                  "s3", op, headers=req.headers,
+                  **({"tenant": dec.tenant} if dec is not None else {})))
+        t0 = time.perf_counter()
+        try:
+            with sp:
+                try:
+                    resp = await handler(req)
+                except AuthError as e:
+                    # mid-stream chunk-signature / truncation failures
+                    sp.status = "auth"
+                    return _err(e.code, str(e), _auth_status(e))
+                except web.HTTPException as e:
+                    sp.status = str(e.status)
+                    raise
+                sp.status = "ok" if resp.status < 400 \
+                    else str(resp.status)
+                return resp
+        finally:
+            if dec is not None:
+                ctrl.release(dec)
+                ctrl.observe("s3", op, dec,
+                             time.perf_counter() - t0)
 
     @property
     def url(self) -> str:

@@ -62,14 +62,35 @@ class FilerServer:
 
         @web.middleware
         async def timing(request, handler):
+            from .. import qos
             t0 = time.perf_counter()
             kind = "read" if request.method in ("GET", "HEAD") \
                 else "write"
+            reserved = request.path.startswith("/__")
+            # tenant admission (seaweedfs_tpu/qos/): classified on the
+            # AWS credential / JWT identity when present, the default
+            # class otherwise; shed answers cost no chunk work
+            ctrl = None if reserved else qos.admission()
+            dec = None
+            if ctrl is not None:
+                # weedlint: ignore[lock-acquire] admission decision, not a mutex: a denied Decision holds nothing, and the admitted path releases in the finally below
+                dec = await ctrl.acquire(
+                    "filer", kind,
+                    qos.tenant_from_headers(request.headers))
+                if not dec.admitted:
+                    return web.json_response(
+                        {"error": "request shed", "reason": dec.reason},
+                        status=dec.status,
+                        headers={"Retry-After": str(
+                            max(1, int(dec.retry_after_s + 0.999)))})
+                qos.set_current_class(dec.cls)
             # filer-tier entry span; the reserved introspection paths
             # (/__metrics__, /__debug__/...) stay out of the ring
-            sp = (tracing._NOOP if request.path.startswith("/__")
-                  else tracing.start_root("filer", kind,
-                                          headers=request.headers))
+            sp = (tracing._NOOP if reserved
+                  else tracing.start_root(
+                      "filer", kind, headers=request.headers,
+                      **({"tenant": dec.tenant} if dec is not None
+                         else {})))
             try:
                 with sp:
                     try:
@@ -81,9 +102,12 @@ class FilerServer:
                                  else str(resp.status))
                     return resp
             finally:
+                dt = time.perf_counter() - t0
+                if dec is not None:
+                    ctrl.release(dec)
+                    ctrl.observe("filer", kind, dec, dt)
                 if metrics.HAVE_PROMETHEUS:
-                    metrics.FILER_REQUEST_TIME.labels(kind).observe(
-                        time.perf_counter() - t0)
+                    metrics.FILER_REQUEST_TIME.labels(kind).observe(dt)
 
         app = web.Application(client_max_size=4 * 1024 * 1024 * 1024,
                               middlewares=[timing])
@@ -114,6 +138,8 @@ class FilerServer:
         app.router.add_post("/__debug__/timeline", h_tl)
         app.router.add_get("/__debug__/events", h_ev)
         app.router.add_get("/__debug__/health", h_hl)
+        from .. import qos
+        app.router.add_get("/__debug__/qos", qos.debug_handler)
         # reserved-prefix path (like /__api__, /__debug__) so a stored
         # file named /metrics is never shadowed; exposes the chunk-cache
         # hit/miss/byte counters among the rest of the registry

@@ -22,6 +22,7 @@ import urllib.parse
 import aiohttp
 from aiohttp import web
 
+from .. import qos
 from ..ec import gf
 from ..ec import pipeline as ecpl
 from ..ec.ec_volume import EcVolumeError
@@ -149,6 +150,12 @@ class VolumeServer:
                                  interval_s=scrub_interval,
                                  pause_ms=scrub_pause_ms,
                                  batch_windows=scrub_batch)
+        # bandwidth arbiter adoption (-qos.mbps): scrub pacing becomes
+        # foreground-aware — the bucket swap is invisible to Scrubber
+        arb = qos.arbiter()
+        if arb is not None:
+            self.scrubber.bucket = arb.adopt(
+                "scrub", self.scrubber.bucket)
         self.app = self._build_app()
         store.fetch_remote_shard = None  # wired after start (needs loop)
 
@@ -349,6 +356,7 @@ class VolumeServer:
         app.router.add_post("/debug/timeline", self.h_timeline)
         app.router.add_get("/debug/events", self.h_events)
         app.router.add_get("/debug/health", self.h_health)
+        app.router.add_get("/debug/qos", self.h_qos)
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_get("/stats/workers", self.h_stats_workers)
@@ -743,6 +751,13 @@ class VolumeServer:
             if metrics.HAVE_PROMETHEUS:
                 metrics.VOLUME_COUNT.set(len(self.store.volumes))
             hb = self.store.collect_heartbeat(self.data_center, self.rack)
+            hb_dict = hb.to_dict()
+            # ride the pulse: report this node's foreground byte rate
+            # so the leader's bandwidth arbiter sees cluster-wide
+            # pressure, and pick up the published budget on the way back
+            arb = qos.arbiter()
+            if arb is not None:
+                hb_dict["qos_fg_bps"] = round(arb.foreground_bps(), 1)
             try:
                 # injected heartbeat faults (FailpointError is an
                 # OSError) take the exact requeue-and-rotate path a
@@ -753,7 +768,7 @@ class VolumeServer:
                 # loop for the session default
                 deadline = max(10.0, 4 * self.pulse_seconds)
                 body = await self._frame_master_post(
-                    "/cluster/heartbeat", hb.to_dict(), deadline)
+                    "/cluster/heartbeat", hb_dict, deadline)
                 if body is not None and body.get("rejected") \
                         and body.get("leader") \
                         and body["leader"] != self.master_url:
@@ -763,12 +778,12 @@ class VolumeServer:
                     # HTTP path's auto-followed 307
                     self.master_url = body["leader"]
                     body = await self._frame_master_post(
-                        "/cluster/heartbeat", hb.to_dict(), deadline)
+                        "/cluster/heartbeat", hb_dict, deadline)
                 if body is None:
                     async with self._http.post(
                             tls.url(self.master_url,
                                     "/cluster/heartbeat"),
-                            json=hb.to_dict(),
+                            json=hb_dict,
                             timeout=aiohttp.ClientTimeout(
                                 total=deadline,
                                 connect=5, sock_read=max(
@@ -792,6 +807,8 @@ class VolumeServer:
                     f"no leader")
             self.volume_size_limit = body.get(
                 "volume_size_limit", self.volume_size_limit)
+            if arb is not None and "qos_mbps" in body:
+                arb.set_budget_mbps(body["qos_mbps"])
             if leader and leader != self.master_url:
                 glog.info("volume %s: chasing new master leader %s "
                           "(was %s)", self.url, leader, self.master_url)
@@ -1600,6 +1617,22 @@ class VolumeServer:
         return web.json_response(slo.health_dict(
             timeline_payload["windows"],
             events=events_payload["events"]))
+
+    async def h_qos(self, req: web.Request) -> web.Response:
+        """/debug/qos: per-tenant admission counters, shed level and
+        bandwidth-arbiter ledger; -workers merged (counters sum, shed
+        level takes the worst worker) like /debug/timeline."""
+        payload = qos.qos_dict()
+        wc = self.worker_ctx
+        if wc is None or self._is_worker_hop(req):
+            return web.json_response(payload)
+        payloads = [payload]
+        for _, body in await self._sibling_get("/debug/qos"):
+            try:
+                payloads.append(json.loads(body))
+            except ValueError:
+                continue
+        return web.json_response(qos.merge_payloads(payloads))
 
     async def h_scrub(self, req: web.Request) -> web.Response:
         """/debug/scrub: paced-scrubber status; POST ?run=1 forces one

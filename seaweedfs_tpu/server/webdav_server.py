@@ -130,26 +130,54 @@ class WebDavServer:
                           else h_requests)(req)
         if (req.method == "GET" and path in (
                 "/__debug__/timeline", "/__debug__/events",
-                "/__debug__/health")) or (
+                "/__debug__/health", "/__debug__/qos")) or (
                 req.method == "POST" and path == "/__debug__/timeline"):
             # flight-recorder twins: shared trio, no drift vs filer/S3
             # (POST only on timeline — ?snap=1 — exactly like the
             # add_get/add_post registrations on every other daemon)
+            from .. import qos
             from ..stats.timeline import recorder_handlers
             h_tl, h_ev, h_hl = recorder_handlers()
             return await {"/__debug__/timeline": h_tl,
                           "/__debug__/events": h_ev,
-                          "/__debug__/health": h_hl}[path](req)
+                          "/__debug__/health": h_hl,
+                          "/__debug__/qos": qos.debug_handler}[path](req)
         handler = getattr(self, f"h_{req.method.lower()}", None)
         if handler is None:
             return web.Response(status=405)
-        # webdav-tier entry span: child client/volume/store spans hang
-        # off it exactly as on the filer/S3 read paths
-        with tracing.start_root("webdav", req.method.lower(),
-                                headers=req.headers) as sp:
-            resp = await handler(req, path)
-            sp.status = "ok" if resp.status < 400 else str(resp.status)
-            return resp
+        from .. import qos
+        op = req.method.lower()
+        # tenant admission (seaweedfs_tpu/qos/): JWT / AWS-credential
+        # identity when present, else the default class
+        ctrl = qos.admission()
+        dec = None
+        if ctrl is not None:
+            # weedlint: ignore[lock-acquire] admission decision, not a mutex: a denied Decision holds nothing, and the admitted path releases in the finally below
+            dec = await ctrl.acquire(
+                "webdav", op, qos.tenant_from_headers(req.headers))
+            if not dec.admitted:
+                return web.Response(
+                    status=dec.status, text="request shed\n",
+                    headers={"Retry-After": str(
+                        max(1, int(dec.retry_after_s + 0.999)))})
+            qos.set_current_class(dec.cls)
+        t0 = time.perf_counter()
+        try:
+            # webdav-tier entry span: child client/volume/store spans
+            # hang off it exactly as on the filer/S3 read paths
+            with tracing.start_root(
+                    "webdav", op, headers=req.headers,
+                    **({"tenant": dec.tenant}
+                       if dec is not None else {})) as sp:
+                resp = await handler(req, path)
+                sp.status = "ok" if resp.status < 400 \
+                    else str(resp.status)
+                return resp
+        finally:
+            if dec is not None:
+                ctrl.release(dec)
+                ctrl.observe("webdav", op, dec,
+                             time.perf_counter() - t0)
 
     # ---- methods ----
 

@@ -114,13 +114,17 @@ def json_ok(obj: dict, status: int = 200) -> WireResponse:
         content_type="application/json; charset=utf-8")
 
 
-def observe(vs, op: str, t0: float) -> None:
+def observe(vs, op: str, t0: float, nbytes: int = 0) -> None:
     dur = time.perf_counter() - t0
     # the scrub pacer's pause-on-foreground-latency signal is THIS
     # feed — the same durations the request-seconds histogram sees, so
     # the pacer and the dashboards agree on what "foreground latency"
     # means (one lock-free deque append; see ec/scrub.ForegroundLoad)
     ec_scrub.foreground.note(dur)
+    # ... and the bandwidth arbiter's foreground-PRESSURE feed is the
+    # served bytes (qos/arbiter.py: background repair yields to this)
+    from .. import qos
+    qos.note_foreground(nbytes)
     from ..stats import metrics
     if metrics.HAVE_PROMETHEUS:
         metrics.VOLUME_REQUEST_TIME.labels(op).observe(dur)
@@ -465,7 +469,7 @@ def _count_served(vs, store, n: Needle, from_cache: bool, sp,
         store.needle_cache.hit(n)
         sp.set("source", "cache")
     vs.count("read", "ok")
-    observe(vs, "read", t0)
+    observe(vs, "read", t0, nbytes=len(n.data or b""))
 
 
 # ---- POST / PUT ----
@@ -555,7 +559,7 @@ async def serve_write(vs, wr: WireRequest,
         return json_err(409, str(e))
     sp.nbytes = len(n.data)
     vs.count("write", "ok")
-    observe(vs, "write", t0)
+    observe(vs, "write", t0, nbytes=len(n.data))
     # replicate unless this IS a replica write (store_replicate.go:21)
     if wr.query.get("type") != "replicate":
         v = vs.store.volumes.get(fid.volume_id)
@@ -823,7 +827,7 @@ async def serve_batch(vs, wr: WireRequest) -> WireResponse:
         sp.set("proxied", sum(len(g) for g in sibling.values()))
     sp.nbytes = len(out)
     vs.count("batch", "ok")
-    observe(vs, "batch", t0)
+    observe(vs, "batch", t0, nbytes=len(out))
     return WireResponse(body=bytes(out),
                         content_type=batchframe.CONTENT_TYPE,
                         headers={"X-Batch-Count": str(len(fids))})

@@ -220,6 +220,46 @@ if HAVE_PROMETHEUS:
         "SeaweedFS_cache_budget_bytes",
         "configured byte budget per read cache (occupancy vs budget)",
         ["cache"], registry=REGISTRY)
+    # multi-tenant QoS (seaweedfs_tpu/qos/): admission decisions,
+    # weighted-fair queue depths, per-tenant latency attribution, and
+    # the background-bandwidth arbiter's grant accounting. Tenant
+    # labels are BOUNDED (BoundedLabelSet below): configured tenants
+    # always keep their own label, unconfigured identities fold into
+    # `other` past the cap — an access-key scan cannot blow up the
+    # registry, the timeline ring, or merge payloads
+    QOS_DECISIONS = Counter(
+        "SeaweedFS_qos_decisions_total",
+        "admission decisions per tenant and outcome "
+        "(admit/throttle/shed)",
+        ["tenant", "decision"], registry=REGISTRY)
+    QOS_QUEUE_DEPTH = Gauge(
+        "SeaweedFS_qos_queue_depth",
+        "requests parked in the weighted-fair admission queue, per "
+        "tenant class",
+        ["tenant"], registry=REGISTRY)
+    QOS_TENANT_REQUEST_TIME = Histogram(
+        "SeaweedFS_qos_tenant_request_seconds",
+        "entry-tier request duration attributed to a tenant "
+        "(per-tenant -slo objectives evaluate against this)",
+        ["tier", "op", "tenant"], registry=REGISTRY)
+    QOS_ARBITER_GRANTED = Counter(
+        "SeaweedFS_qos_arbiter_granted_bytes_total",
+        "background bytes admitted through the bandwidth arbiter, "
+        "per consumer",
+        ["kind"], registry=REGISTRY)
+    QOS_ARBITER_YIELDS = Counter(
+        "SeaweedFS_qos_arbiter_yields_total",
+        "arbiter grants squeezed below base rate by foreground "
+        "pressure, per consumer",
+        ["kind"], registry=REGISTRY)
+    QOS_ARBITER_RATE = Gauge(
+        "SeaweedFS_qos_arbiter_rate_bytes_s",
+        "currently-granted background rate per arbiter consumer",
+        ["kind"], registry=REGISTRY)
+    QOS_FOREGROUND_BPS = Gauge(
+        "SeaweedFS_qos_foreground_bytes_s",
+        "foreground byte rate observed by the bandwidth arbiter",
+        registry=REGISTRY)
     # SLO burn-rate engine (stats/slo.py)
     SLO_STATUS = Gauge(
         "SeaweedFS_slo_status",
@@ -239,6 +279,39 @@ if HAVE_PROMETHEUS:
 else:  # pragma: no cover
     def metrics_text() -> bytes:
         return b"# prometheus_client unavailable\n"
+
+
+class BoundedLabelSet:
+    """Cardinality armor for identity-derived metric labels.
+
+    The first `cap` distinct keys keep their own label value; every
+    key after that maps to `"other"`. Seed keys (the configured
+    tenants) are reserved up front and can never be displaced by a
+    scan — a client hammering 10k random access keys costs at most
+    `cap` label values in the registry, not 10k.
+
+    Thread-free by design: admission runs on the event loop; the set
+    only grows, so a racy double-add is harmless anyway."""
+
+    OTHER = "other"
+
+    __slots__ = ("cap", "_seen")
+
+    def __init__(self, cap: int = 32, seed=()):
+        self.cap = max(int(cap), 1)
+        self._seen = set(seed)
+        self._seen.add(self.OTHER)
+
+    def get(self, key: str) -> str:
+        if key in self._seen:
+            return key
+        if len(self._seen) < self.cap:
+            self._seen.add(key)
+            return key
+        return self.OTHER
+
+    def __len__(self) -> int:
+        return len(self._seen)
 
 
 # Gauges where summing across workers fabricates a value no process

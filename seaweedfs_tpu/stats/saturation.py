@@ -34,8 +34,10 @@ import time
 
 _lag_lock = threading.Lock()
 _lag_max = 0.0
+_lag_last = 0.0         # last flushed window max (peekable between snaps)
 
 _exec_probe_running = False
+_exec_wait_last = 0.0   # last measured executor queue wait (seconds)
 
 
 def note_loop_lag(lag_s: float) -> None:
@@ -49,13 +51,28 @@ def note_loop_lag(lag_s: float) -> None:
 def sample_loop_lag() -> None:
     """Flush the max observed scheduling lag to the gauge (and reset
     the max, so each window reports its own worst case)."""
-    global _lag_max
+    global _lag_max, _lag_last
     from . import metrics
     if not metrics.HAVE_PROMETHEUS:
         return
     with _lag_lock:
         lag, _lag_max = _lag_max, 0.0
+        _lag_last = lag
     metrics.EVENTLOOP_LAG.set(round(lag, 6))
+
+
+def current_lag_s() -> float:
+    """Live peek for the QoS shedder (seaweedfs_tpu/qos/): the worst
+    scheduling lag seen this window or the last flushed one —
+    whichever is worse — WITHOUT resetting the running max."""
+    with _lag_lock:
+        return max(_lag_max, _lag_last)
+
+
+def current_exec_wait_s() -> float:
+    """Last measured executor queue wait (the QoS shedder's
+    disk-path saturation signal)."""
+    return _exec_wait_last
 
 
 def sample_process() -> None:
@@ -115,8 +132,9 @@ def start_executor_probe(loop, period_s: float = 10.0) -> None:
                     timeout=5.0)
             except asyncio.TimeoutError:
                 pass
-            metrics.EXECUTOR_WAIT.set(
-                round(time.perf_counter() - t0, 6))
+            global _exec_wait_last
+            _exec_wait_last = time.perf_counter() - t0
+            metrics.EXECUTOR_WAIT.set(round(_exec_wait_last, 6))
             pool = getattr(loop, "_default_executor", None)
             q = getattr(pool, "_work_queue", None)
             if q is not None:

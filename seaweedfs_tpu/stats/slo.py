@@ -51,14 +51,21 @@ SLOW_WINDOW_S = 600.0
 MIN_COUNT = 10          # fast-window request floor before paging
 
 # journal event types worth correlating into violation evidence
+# (tenant_shed / arbiter_yield: a paying tenant's burn alongside the
+# abuser being shed or background repair yielding IS the explanation)
 EVIDENCE_TYPES = {"breaker_open", "breaker_close",
                   "retry_budget_exhausted", "holder_refresh",
-                  "scrub_corruption", "worker_respawn"}
+                  "scrub_corruption", "worker_respawn",
+                  "tenant_shed", "arbiter_yield"}
 
 _HIST = "SeaweedFS_request_duration_seconds"
+# per-tenant objectives (`tier.op/tenant:...`) evaluate against the
+# tenant-attributed entry histogram instead (seaweedfs_tpu/qos/)
+_TENANT_HIST = "SeaweedFS_qos_tenant_request_seconds"
 
 _SPEC_RE = re.compile(
-    r"^(?P<tier>[a-z0-9_]+)\.(?P<op>[a-z0-9_.]+):"
+    r"^(?P<tier>[a-z0-9_]+)\.(?P<op>[a-z0-9_.]+)"
+    r"(?:/(?P<tenant>[A-Za-z0-9._-]+))?:"
     r"p(?P<q>\d{1,2}(?:\.\d+)?)<(?P<thresh>\d+(?:\.\d+)?)"
     r"(?P<unit>ms|s)@(?P<obj>\d{1,2}(?:\.\d+)?)$")
 
@@ -66,20 +73,25 @@ STATUS_LEVELS = {"ok": 0, "warn": 1, "page": 2}
 
 
 class SloSpec:
-    """One parsed objective."""
+    """One parsed objective. `tier.op/tenant:pQQ<NNms@OBJ` scopes the
+    objective to ONE tenant's entry-tier latency (the bounded tenant
+    label from seaweedfs_tpu/qos/) — the paying tenant keeps an armed
+    objective while the abuser is shed around it."""
 
-    __slots__ = ("raw", "tier", "op", "quantile", "threshold_s",
-                 "objective")
+    __slots__ = ("raw", "tier", "op", "tenant", "quantile",
+                 "threshold_s", "objective")
 
     def __init__(self, raw: str):
         m = _SPEC_RE.match(raw.strip())
         if m is None:
             raise ValueError(
-                f"bad -slo spec {raw!r}: want tier.op:pQQ<NNms@OBJ "
+                f"bad -slo spec {raw!r}: want "
+                f"tier.op[/tenant]:pQQ<NNms@OBJ "
                 f"(e.g. volume.read:p99<50ms@99.9)")
         self.raw = raw.strip()
         self.tier = m.group("tier")
         self.op = m.group("op")
+        self.tenant = m.group("tenant") or ""
         self.quantile = float(m.group("q")) / 100.0
         thresh = float(m.group("thresh"))
         self.threshold_s = thresh / 1000.0 if m.group("unit") == "ms" \
@@ -94,10 +106,13 @@ class SloSpec:
         return 1.0 - self.objective
 
     def to_dict(self) -> dict:
-        return {"spec": self.raw, "tier": self.tier, "op": self.op,
-                "quantile": self.quantile,
-                "threshold_ms": round(self.threshold_s * 1000.0, 3),
-                "objective": self.objective}
+        d = {"spec": self.raw, "tier": self.tier, "op": self.op,
+             "quantile": self.quantile,
+             "threshold_ms": round(self.threshold_s * 1000.0, 3),
+             "objective": self.objective}
+        if self.tenant:
+            d["tenant"] = self.tenant
+        return d
 
 
 def parse_specs(raws: "list[str]") -> "list[SloSpec]":
@@ -111,6 +126,11 @@ def parse_specs(raws: "list[str]") -> "list[SloSpec]":
 def _matches(spec: SloSpec, base_key: str) -> bool:
     from .timeline import split_key
     name, labels = split_key(base_key)
+    if spec.tenant:
+        return (name == _TENANT_HIST
+                and labels.get("tier") == spec.tier
+                and labels.get("op") == spec.op
+                and labels.get("tenant") == spec.tenant)
     return (name == _HIST and labels.get("tier") == spec.tier
             and labels.get("op") == spec.op)
 

@@ -103,6 +103,16 @@ class WeedClient:
         # are open over frames exactly like the HTTP listeners
         self._frame_hub = None
 
+    @staticmethod
+    def _budget_key(upstream: str) -> str:
+        """Retry-budget pool key: upstream + the requesting tenant's
+        QoS class (set by the entry-tier admission middleware), so an
+        abusive tenant hammering a flapping volume drains only its
+        own pool — not the paying tenant's."""
+        from .. import qos
+        cls = qos.current_class()
+        return f"{upstream}|{cls}" if cls else upstream
+
     async def __aenter__(self) -> "WeedClient":
         if self._session is None:
             self._session = tls.make_session(timeout=DATA_TIMEOUT)
@@ -158,7 +168,7 @@ class WeedClient:
             tracing.inject(headers, sp)
         attempt = 0
         try:
-            async for _ in self.retry.attempts():
+            async for _ in self.retry.attempts(self._budget_key("master")):
                 attempt += 1
                 if attempt > 1:
                     sp.event("retry", attempt=attempt)
@@ -367,7 +377,7 @@ class WeedClient:
         last: object = None
         attempt = 0
         try:
-            async for _ in self.retry.attempts():
+            async for _ in self.retry.attempts(self._budget_key(url)):
                 attempt += 1
                 if attempt > 1:
                     sp.event("retry", attempt=attempt)

@@ -70,6 +70,13 @@ TYPES = frozenset({
     "frame_downgrade",          # a peer refused the frame handshake:
                                 # its requests ride HTTP until the
                                 # jittered re-probe window expires
+    "tenant_shed",              # QoS admission throttled (429) or shed
+                                # (503) a tenant's request — rate-
+                                # bounded per tenant so an abuser can't
+                                # flood the ring holding its evidence
+    "arbiter_yield",            # the bandwidth arbiter squeezed a
+                                # background consumer below its base
+                                # rate under foreground pressure
 })
 
 _MAX_FIELDS = 16                # per-event field cap (bounded memory)

@@ -40,21 +40,54 @@ class RetryBudget:
     one. When the bucket is empty, retries are denied and the caller
     fails fast — under a full outage the extra load from retries is
     bounded at `ratio` of the offered load (the SRE-book discipline).
-    """
+
+    The budget is KEYED (upstream + tenant class, util/client.py):
+    each key gets its own token pool, so an abusive tenant burning
+    retries against one flapping volume exhausts only its own pool —
+    a paying tenant retrying against a healthy upstream is untouched.
+    The un-keyed calls ("" key) keep the original process-global
+    behavior. Pools are capped; past the cap everything shares an
+    overflow pool rather than growing without bound."""
+
+    MAX_POOLS = 256
+    OVERFLOW = "~overflow"
 
     def __init__(self, ratio: float = 0.2, burst: float = 10.0):
         self.ratio = ratio
         self.burst = burst
-        self.tokens = burst
+        self._pools: dict[str, float] = {"": burst}
 
-    def record_attempt(self) -> None:
-        self.tokens = min(self.burst, self.tokens + self.ratio)
+    def _key(self, key: str) -> str:
+        if key in self._pools or len(self._pools) < self.MAX_POOLS:
+            return key
+        return self.OVERFLOW
 
-    def allow_retry(self) -> bool:
-        if self.tokens >= 1.0:
-            self.tokens -= 1.0
+    def record_attempt(self, key: str = "") -> None:
+        k = self._key(key)
+        self._pools[k] = min(self.burst,
+                             self._pools.get(k, self.burst) + self.ratio)
+
+    def allow_retry(self, key: str = "") -> bool:
+        k = self._key(key)
+        tokens = self._pools.get(k, self.burst)
+        if tokens >= 1.0:
+            self._pools[k] = tokens - 1.0
             return True
+        self._pools[k] = tokens
         return False
+
+    @property
+    def tokens(self) -> float:
+        """The process-global pool (back-compat introspection)."""
+        return self._pools.get("", self.burst)
+
+    @tokens.setter
+    def tokens(self, value: float) -> None:
+        self._pools[""] = value
+
+    def to_dict(self) -> dict:
+        return {k or "(global)": round(v, 3)
+                for k, v in sorted(self._pools.items())}
 
 
 class RetryPolicy:
@@ -96,27 +129,33 @@ class RetryPolicy:
         cap = min(self.max_delay, self.base_delay * (2 ** (attempt - 1)))
         return self._rng.uniform(0, cap)
 
-    async def attempts(self):
-        """Async generator of attempt indices 0..max_attempts-1."""
+    async def attempts(self, budget_key: str = ""):
+        """Async generator of attempt indices 0..max_attempts-1.
+
+        `budget_key` selects the retry-budget pool (upstream + tenant
+        class, see RetryBudget) — callers that know their upstream
+        pass it so one flapping target can't drain everyone's
+        retries; the default keeps the process-global pool."""
         deadline = self._clock() + self.total_timeout
         for attempt in range(self.max_attempts):
             if attempt:
                 if self.budget is not None and \
-                        not self.budget.allow_retry():
+                        not self.budget.allow_retry(budget_key):
                     # budget exhausted: fail fast — and journal it,
                     # because a brown-out's retry storm hitting the
                     # ceiling is exactly the transition an operator
                     # reading /debug/health evidence needs to see
                     from . import events
                     events.record("retry_budget_exhausted",
-                                  name=self.name, attempt=attempt)
+                                  name=self.name, key=budget_key,
+                                  attempt=attempt)
                     return
                 delay = self.backoff(attempt)
                 if self._clock() + delay >= deadline:
                     return
                 await self._sleep(delay)
             elif self.budget is not None:
-                self.budget.record_attempt()
+                self.budget.record_attempt(budget_key)
             if self._clock() >= deadline:
                 return
             yield attempt

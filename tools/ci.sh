@@ -114,6 +114,17 @@ if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/chaos.py ha --quick; t
     exit 1
 fi
 
+echo "== qos smoke (soak qos --quick: abuser shed, paying SLO holds, arbiter budget) =="
+if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/soak.py qos --quick; then
+    echo "qos smoke: FAILED (multi-tenant admission regression — the"
+    echo "paying tenant's objective must hold while a flooding abuser"
+    echo "is throttled/shed on its own class, the qos.admit failpoint"
+    echo "must answer an honest 503 + Retry-After, the abuser must be"
+    echo "readmitted after the flood stops, and every acked write must"
+    echo "read back byte-identical; see output above)"
+    exit 1
+fi
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \

@@ -85,7 +85,9 @@ def main() -> int:
               os.path.join(tmp, "v"), "-max", "10", "-master", master,
               "-pulseSeconds", "1", "-workers", "2",
               "-timeline.interval", "2",
-              "-slo", "volume.read:p99<250ms@99")
+              "-slo", "volume.read:p99<250ms@99",
+              "-qos.tenant", "smoke:4:100",
+              "-qos.mbps", "50")
         wait_assign(master)
 
         # traffic across both workers' vid partitions
@@ -180,6 +182,31 @@ def main() -> int:
             check(key in cyc, f"scrub cycle missing {key!r}")
         print(f"  scrub: {len(sc['workers'])} workers merged, cycle "
               f"keys OK")
+
+        # -- /debug/qos (admission + arbiter schema, -workers merged) ---
+        qd = get_json(vol, "/debug/qos")
+        check(qd.get("workers") == 2, "/debug/qos not worker-merged")
+        q = qd["qos"]
+        for key in ("tenants", "inflight", "inflight_limit", "queued",
+                    "shed_level", "ladder", "thresholds", "probes",
+                    "arbiter"):
+            check(key in q, f"/debug/qos missing {key!r}")
+        check("smoke" in q["tenants"],
+              f"-qos.tenant class absent (saw {sorted(q['tenants'])})")
+        trow = q["tenants"]["smoke"]
+        for key in ("admitted", "throttled", "shed", "queued", "cls",
+                    "weight", "rps", "burst", "tokens", "queue_depth"):
+            check(key in trow, f"qos tenant row missing {key!r}")
+        arb = q["arbiter"]
+        for key in ("budget_mbps", "floor", "foreground_bps",
+                    "consumers", "grants"):
+            check(key in arb, f"qos arbiter missing {key!r}")
+        check("scrub" in arb["consumers"],
+              f"scrub bucket not adopted by the arbiter "
+              f"(consumers: {sorted(arb['consumers'])})")
+        print(f"  qos: {len(q['tenants'])} tenant classes, arbiter "
+              f"budget {arb['budget_mbps']} MiB/s, "
+              f"{len(arb['consumers'])} adopted consumer(s)")
 
         # -- raft surfaces on the master (HA control plane schema) ------
         mtl = get_json(master, "/debug/timeline?snap=1", method="POST")

@@ -39,6 +39,16 @@ over real sockets, and byte-verifies every surviving file at the end.
                                        # violating timeline slice + a
                                        # correlated journal event in the
                                        # evidence; disarmed phase stays ok
+    python tools/soak.py qos           # multi-tenant QoS acceptance: an
+                                       # abusive S3 tenant at full
+                                       # throttle vs a paying tenant with
+                                       # an armed per-tenant -slo — the
+                                       # paying objective must hold, all
+                                       # throttle/shed decisions must land
+                                       # on the abuser's class, and every
+                                       # acked write must read back
+                                       # byte-identical (--quick: the
+                                       # ci.sh smoke)
     python tools/soak.py all
 
 Exit code 0 only when every read verifies.
@@ -1181,6 +1191,265 @@ async def scenario_slo(tmp: str) -> int:
         procs.kill_all()
 
 
+def _sign_s3(method: str, host: str, path: str,
+             access_key: str, secret: str) -> dict:
+    """Client-side SigV4 (UNSIGNED-PAYLOAD), the way an SDK signs —
+    the soak's S3 traffic must carry REAL verified identities so the
+    gateway's tenant classification keys on the access key."""
+    import hashlib
+    import hmac
+    from seaweedfs_tpu.s3.auth import ALGORITHM, UNSIGNED, signing_key
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    date = amz_date[:8]
+    headers = {"host": host, "x-amz-date": amz_date,
+               "x-amz-content-sha256": UNSIGNED}
+    signed = sorted(headers)
+    canon = "\n".join([
+        method, path, "",
+        "".join(f"{h}:{headers[h]}\n" for h in signed),
+        ";".join(signed), UNSIGNED])
+    scope = f"{date}/us-east-1/s3/aws4_request"
+    sts = "\n".join([ALGORITHM, amz_date, scope,
+                     hashlib.sha256(canon.encode()).hexdigest()])
+    sig = hmac.new(signing_key(secret, date, "us-east-1"), sts.encode(),
+                   hashlib.sha256).hexdigest()
+    headers["Authorization"] = (
+        f"{ALGORITHM} Credential={access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={sig}")
+    return headers
+
+
+async def scenario_qos(tmp: str) -> int:
+    """Multi-tenant QoS acceptance: an S3 gateway with two REAL SigV4
+    identities — `PAYKEY` (weight 8, effectively unlimited rps, an
+    armed per-tenant -slo objective) and `ABUSEKEY` (weight 1, tight
+    rps) — serves a paying workload while the abuser floods zipf GETs
+    at full throttle. The gate: /__debug__/health keeps the paying
+    objective ok THROUGH the flood, every throttle/shed decision lands
+    on the abuser's class only (paying sheds stay exactly 0), the
+    `qos.admit` failpoint proves the chaos path end to end, the abuser
+    is readmitted within a couple of bucket horizons once it stops,
+    and every byte the gateway ever acked reads back identical."""
+    import aiohttp
+    procs = Procs(tmp)
+    quick = "--quick" in sys.argv
+    failures = 0
+    try:
+        port0 = BASE_PORT + 140
+        master = f"127.0.0.1:{port0}"
+        await procs.spawn("master", "-port", str(port0),
+                    "-mdir", os.path.join(procs.tmp, "m"),
+                    "-volumeSizeLimitMB", "8", "-pulseSeconds", "1",
+                    "-qos.mbps", "64")
+        await asyncio.sleep(2)
+        await procs.spawn("volume", "-port", str(port0 + 1),
+                    "-dir", os.path.join(procs.tmp, "v"),
+                    "-max", "20", "-master", master,
+                    "-pulseSeconds", "1", "-qos.mbps", "64")
+        s3port = port0 + 2
+        s3host = f"127.0.0.1:{s3port}"
+        await procs.spawn(
+            "s3", "-port", str(s3port), "-master", master,
+            "-store", "memory",
+            "-accessKey", "PAYKEY", "-secretKey", "PAYSECRET",
+            "-accessKey", "ABUSEKEY", "-secretKey", "ABUSESECRET",
+            "-qos.tenant", "PAYKEY:8:1000:2000",
+            "-qos.tenant", "ABUSEKEY:1:25:25",
+            # armed thresholds sized far above this light load: the
+            # ladder plumbing runs live, but the scenario's shed
+            # evidence stays the deterministic rate-limit path
+            "-qos.shed.lagms", "2000", "-qos.shed.waitms", "2000",
+            "-timeline.interval", "1",
+            "-slo", "s3.get/PAYKEY:p99<1500ms@99")
+        await wait_assign(master)
+        for _ in range(60):     # gateway readiness
+            try:
+                _http_json(s3port, "/__debug__/health")
+                break
+            except OSError:
+                await asyncio.sleep(0.5)
+
+        rng = random.Random(17)
+        n_objects = 30 if quick else 120
+        abuse_s = 8 if quick else 40
+        payloads: dict[str, bytes] = {}
+        stats = {"pay_reads": 0, "pay_errors": 0, "abuse_200": 0,
+                 "abuse_429": 0, "abuse_503": 0, "abuse_other": 0,
+                 "pay_stale": 0}
+
+        def qos_snapshot() -> dict:
+            return _http_json(s3port, "/__debug__/qos")["qos"]
+
+        def health() -> dict:
+            _http_json(s3port, "/__debug__/timeline?snap=1", "POST")
+            return _http_json(s3port, "/__debug__/health")
+
+        async with aiohttp.ClientSession() as http:
+            async def s3req(method: str, path: str, key: str,
+                            secret: str, data: bytes | None = None):
+                h = _sign_s3(method, s3host, path, key, secret)
+                return await http.request(
+                    method, f"http://{s3host}{path}", headers=h,
+                    data=data)
+
+            # -- phase 1: the paying tenant fills (every ack recorded)
+            async with await s3req("PUT", "/qosbkt", "PAYKEY",
+                                   "PAYSECRET") as r:
+                assert r.status == 200, await r.text()
+            sem = asyncio.Semaphore(16)
+
+            async def put(i: int) -> None:
+                body = rng.randbytes(rng.randint(2000, 20000))
+                path = f"/qosbkt/obj-{i}"
+                async with sem:
+                    async with await s3req("PUT", path, "PAYKEY",
+                                           "PAYSECRET", body) as r:
+                        if r.status == 200:
+                            payloads[path] = body
+                        else:
+                            stats["pay_errors"] += 1
+
+            await asyncio.gather(*(put(i) for i in range(n_objects)))
+            print(f"  fill: {len(payloads)}/{n_objects} acked writes")
+            if len(payloads) != n_objects:
+                print("  FAIL: paying writes rejected during fill")
+                failures += 1
+            paths = sorted(payloads)
+
+            # -- phase 2: abuser floods, paying keeps reading ---------
+            stop = asyncio.Event()
+
+            async def abuser() -> None:
+                while not stop.is_set():
+                    path = rng.choice(paths)
+                    try:
+                        async with await s3req("GET", path, "ABUSEKEY",
+                                               "ABUSESECRET") as r:
+                            await r.read()
+                            if r.status == 200:
+                                stats["abuse_200"] += 1
+                            elif r.status == 429:
+                                stats["abuse_429"] += 1
+                            elif r.status == 503:
+                                stats["abuse_503"] += 1
+                            else:
+                                stats["abuse_other"] += 1
+                    except aiohttp.ClientError:
+                        stats["abuse_other"] += 1
+
+            async def paying() -> None:
+                while not stop.is_set():
+                    path = rng.choice(paths)
+                    try:
+                        async with await s3req("GET", path, "PAYKEY",
+                                               "PAYSECRET") as r:
+                            body = await r.read()
+                            if r.status != 200:
+                                stats["pay_errors"] += 1
+                            elif body != payloads[path]:
+                                stats["pay_stale"] += 1
+                            else:
+                                stats["pay_reads"] += 1
+                    except aiohttp.ClientError:
+                        stats["pay_errors"] += 1
+                    await asyncio.sleep(0.02)
+
+            tasks = [asyncio.create_task(abuser()) for _ in range(6)]
+            tasks += [asyncio.create_task(paying()) for _ in range(2)]
+            t0 = time.monotonic()
+            ok_polls = polls = 0
+            while time.monotonic() - t0 < abuse_s:
+                await asyncio.sleep(3)
+                h = await asyncio.to_thread(health)
+                polls += 1
+                if h["status"] == "ok":
+                    ok_polls += 1
+                print(f"  flood: health={h['status']} "
+                      f"pay={stats['pay_reads']} "
+                      f"abuse 200/429/503="
+                      f"{stats['abuse_200']}/{stats['abuse_429']}"
+                      f"/{stats['abuse_503']}")
+            stop.set()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+            if ok_polls < polls:
+                print(f"  FAIL: paying objective left ok during the "
+                      f"flood ({ok_polls}/{polls} ok)")
+                failures += 1
+            if stats["pay_errors"] or stats["pay_stale"]:
+                print(f"  FAIL: paying tenant saw "
+                      f"{stats['pay_errors']} errors / "
+                      f"{stats['pay_stale']} stale reads in the flood")
+                failures += 1
+            if not (stats["abuse_429"] + stats["abuse_503"]):
+                print("  FAIL: abuser at full throttle was never "
+                      "throttled")
+                failures += 1
+            q = await asyncio.to_thread(qos_snapshot)
+            pay = q["tenants"]["PAYKEY"]
+            abu = q["tenants"]["ABUSEKEY"]
+            if pay["throttled"] or pay["shed"]:
+                print(f"  FAIL: sheds landed on the paying class "
+                      f"(throttled={pay['throttled']} "
+                      f"shed={pay['shed']})")
+                failures += 1
+            if not (abu["throttled"] + abu["shed"]):
+                print("  FAIL: no decision attributed to the abuser's "
+                      "class")
+                failures += 1
+            print(f"  qos: abuser throttled={abu['throttled']} "
+                  f"shed={abu['shed']}; paying admitted="
+                  f"{pay['admitted']} throttled=0 shed=0")
+
+            # -- phase 3: the qos.admit chaos path --------------------
+            await asyncio.to_thread(
+                _http_json, s3port,
+                "/__debug__/failpoints?site=qos.admit&spec=error:2",
+                "POST")
+            async with await s3req("GET", paths[0], "PAYKEY",
+                                   "PAYSECRET") as r:
+                if r.status != 503 or "Retry-After" not in r.headers:
+                    print(f"  FAIL: armed qos.admit answered "
+                          f"{r.status} without Retry-After")
+                    failures += 1
+            await asyncio.to_thread(
+                _http_json, s3port, "/__debug__/failpoints", "DELETE")
+
+            # -- phase 4: abuser recovery after the flood stops -------
+            readmitted = False
+            for _ in range(20):
+                await asyncio.sleep(0.5)
+                async with await s3req("GET", paths[0], "ABUSEKEY",
+                                       "ABUSESECRET") as r:
+                    await r.read()
+                    if r.status == 200:
+                        readmitted = True
+                        break
+            if not readmitted:
+                print("  FAIL: abuser never readmitted after backing "
+                      "off")
+                failures += 1
+            h = await asyncio.to_thread(health)
+            if h["status"] != "ok":
+                print(f"  FAIL: health {h['status']} after the flood "
+                      f"ended")
+                failures += 1
+
+            # -- phase 5: zero lost acked writes ----------------------
+            bad = 0
+            for path in paths:
+                async with await s3req("GET", path, "PAYKEY",
+                                       "PAYSECRET") as r:
+                    body = await r.read()
+                    if r.status != 200 or body != payloads[path]:
+                        bad += 1
+            print(f"  verify: bad={bad}/{len(paths)} acked objects, "
+                  f"readmitted={readmitted}, health={h['status']}")
+            return failures + bad
+    finally:
+        procs.kill_all()
+
+
 async def scenario_heal(tmp: str) -> int:
     """Autopilot acceptance (ISSUE 12): a fleet with the scrubber and
     the autopilot BOTH running autonomously. Real bit-rot is planted
@@ -1449,6 +1718,7 @@ SCENARIOS = {
     "scrub": scenario_scrub,
     "heal": scenario_heal,
     "slo": scenario_slo,
+    "qos": scenario_qos,
 }
 
 
