@@ -313,6 +313,21 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("-cache.dir", dest="cache_dir", default="",
                    help="directory for the mmap-backed disk cache tier "
                         "(empty = memory-only)")
+    f.add_argument("-shard.id", dest="shard_id", type=int, default=0,
+                   help="this filer's shard id in a sharded metadata "
+                        "plane (0-based)")
+    f.add_argument("-shard.of", dest="shard_of", type=int, default=1,
+                   help="total filer shards; >1 enables prefix sharding "
+                        "against the master's raft-committed shard map")
+    f.add_argument("-shard.peers", dest="shard_peers", default="",
+                   help="comma list of filer host:port addresses indexed "
+                        "by shard id (fallback when the committed map "
+                        "has not learned an owner yet)")
+    f.add_argument("-shard.splitMbps", dest="shard_split_mbps",
+                   type=float, default=8.0,
+                   help="token-bucket pacing for shard split/move "
+                        "migration batches (adopted by the -qos.mbps "
+                        "arbiter when present)")
 
     fc = sub.add_parser("filer.copy",
                         help="parallel-upload local files/trees to a filer")
@@ -365,6 +380,15 @@ def build_parser() -> argparse.ArgumentParser:
                           "(0 disables)")
     s3p.add_argument("-cache.dir", dest="cache_dir", default="",
                      help="directory for the mmap-backed disk cache tier")
+    s3p.add_argument("-shard.id", dest="shard_id", type=int, default=0,
+                     help="this gateway's filer shard id in a sharded "
+                          "gateway fleet")
+    s3p.add_argument("-shard.of", dest="shard_of", type=int, default=1,
+                     help="total gateway shards; >1 enables 307 "
+                          "routing of foreign buckets to siblings")
+    s3p.add_argument("-shard.peers", dest="shard_peers", default="",
+                     help="comma list of sibling gateway host:port "
+                          "addresses indexed by shard id")
 
     wd = sub.add_parser("webdav", help="start a WebDAV gateway")
     _add_common(wd)
@@ -380,6 +404,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "(0 disables)")
     wd.add_argument("-cache.dir", dest="cache_dir", default="",
                     help="directory for the mmap-backed disk cache tier")
+    wd.add_argument("-shard.id", dest="shard_id", type=int, default=0,
+                    help="this gateway's filer shard id in a sharded "
+                         "gateway fleet")
+    wd.add_argument("-shard.of", dest="shard_of", type=int, default=1,
+                    help="total gateway shards; >1 enables 307 "
+                         "routing of foreign paths to siblings")
+    wd.add_argument("-shard.peers", dest="shard_peers", default="",
+                    help="comma list of sibling gateway host:port "
+                         "addresses indexed by shard id")
 
     srv = sub.add_parser("server",
                          help="combined master+volume+filer+s3 in one process")
@@ -868,10 +901,18 @@ async def _run_filer(args) -> None:
                      disable_dir_listing=args.disableDirListing,
                      dir_list_limit=args.dirListLimit,
                      cache_mem_bytes=args.cache_mem * 1024 * 1024,
-                     cache_dir=args.cache_dir)
+                     cache_dir=args.cache_dir,
+                     shard_id=args.shard_id, shard_of=args.shard_of,
+                     shard_peers={i: p.strip() for i, p in
+                                  enumerate(args.shard_peers.split(","))
+                                  if p.strip()},
+                     shard_split_mbps=args.shard_split_mbps)
     await fs.start()
     rec = _start_recorder()
-    print(f"filer listening on {fs.url} (store={args.store})")
+    shard_note = (f", shard {args.shard_id}/{args.shard_of}"
+                  if args.shard_of > 1 else "")
+    print(f"filer listening on {fs.url} (store={args.store}"
+          f"{shard_note})")
     try:
         await _serve_until_interrupt(fs)
     finally:
@@ -1057,6 +1098,17 @@ async def _run_filer_replicate(args) -> None:
                 closer()
 
 
+def _gateway_router(args):
+    """-shard.of > 1: build the GatewayRouter for a sharded S3/WebDAV
+    fleet (one gateway per filer shard, siblings from -shard.peers)."""
+    if getattr(args, "shard_of", 1) <= 1:
+        return None
+    from .filer.shard import GatewayRouter
+    peers = {i: p.strip() for i, p in
+             enumerate(args.shard_peers.split(",")) if p.strip()}
+    return GatewayRouter(args.shard_id, args.master, peers)
+
+
 async def _run_s3(args) -> None:
     from .filer.filer import Filer
     from .s3.gateway import S3Gateway
@@ -1072,7 +1124,8 @@ async def _run_s3(args) -> None:
                    ip=args.ip, port=args.port, identities=identities,
                    domain_name=args.domainName,
                    cache_mem_bytes=args.cache_mem * 1024 * 1024,
-                   cache_dir=args.cache_dir)
+                   cache_dir=args.cache_dir,
+                   shard_router=_gateway_router(args))
     await s3.start()
     rec = _start_recorder()
     print(f"s3 gateway listening on {s3.url}")
@@ -1097,7 +1150,8 @@ async def _run_webdav(args) -> None:
         replication=args.replication,
         chunk_size=args.chunkSizeMB * 1024 * 1024,
         cache_mem_bytes=args.cache_mem * 1024 * 1024,
-        cache_dir=args.cache_dir))
+        cache_dir=args.cache_dir,
+        shard_router=_gateway_router(args)))
     await wd.start()
     rec = _start_recorder()
     print(f"webdav listening on {wd.url} (store={args.store})")

@@ -35,6 +35,10 @@ class FilerStore(ABC):
     def list_directory_entries(self, dir_path: str, start_file: str,
                                inclusive: bool, limit: int) -> list[Entry]: ...
 
+    def count_entries(self) -> int:
+        """Total entries held (shard observability); -1 = unsupported."""
+        return -1
+
     def begin_transaction(self):  # optional
         return None
 

@@ -46,6 +46,11 @@ class MemoryStore(FilerStore):
                 if i < len(names) and names[i] == e.name:
                     names.pop(i)
 
+    def count_entries(self) -> int:
+        with self._lock:
+            # root stub excluded: it exists on every shard
+            return sum(1 for p in self._entries if p != "/")
+
     def delete_folder_children(self, path: str) -> None:
         with self._lock:
             prefix = path.rstrip("/") or "/"

@@ -73,6 +73,11 @@ class SqliteStore(FilerStore):
         self._conn().execute(
             "DELETE FROM filemeta WHERE directory=? AND name=?", (d, n))
 
+    def count_entries(self) -> int:
+        row = self._conn().execute(
+            "SELECT COUNT(*) FROM filemeta WHERE name != '/'").fetchone()
+        return int(row[0])
+
     def delete_folder_children(self, path: str) -> None:
         p = path.rstrip("/") or "/"
         esc = p.replace("\\", "\\\\").replace("%", "\\%").replace("_", "\\_")

@@ -81,12 +81,17 @@ class Election:
         # file-id reservation ceiling (ids below it are spoken for by
         # some committed reservation window — sequence.RaftSequencer)
         self.snap = {"last_index": 0, "last_term": 0, "value": 0,
-                     "seq": 0}
+                     "seq": 0, "shard_epoch": 0, "shard_map": None}
         self.entries: list[dict] = []
         self.commit = 0
         self.applied = 0
         self.applied_value = 0
         self.applied_seq = 0
+        # applied filer shard map (filer/shard.py): epoch + the last
+        # committed map dict; transitions CAS on the epoch at APPLY
+        # time so a deposed leader's stale map proposal is a no-op
+        self.applied_shard_epoch = 0
+        self.applied_shard: dict | None = None
         # durable (term, votedFor, snapshot, log), written BEFORE any
         # vote/append takes effect: without it a restarted master forgets
         # it voted and can grant a second vote in the same term — a
@@ -106,9 +111,13 @@ class Election:
                     f"election state {state_path} unreadable/corrupt: {e};"
                     f" repair or remove it explicitly") from e
             self.snap.setdefault("seq", 0)   # pre-HA state files
+            self.snap.setdefault("shard_epoch", 0)   # pre-shard files
+            self.snap.setdefault("shard_map", None)
             self.commit = self.applied = self.snap["last_index"]
             self.applied_value = self.snap["value"]
             self.applied_seq = self.snap["seq"]
+            self.applied_shard_epoch = self.snap["shard_epoch"]
+            self.applied_shard = self.snap["shard_map"]
         self.role = self.LEADER if self.single else self.FOLLOWER
         self.leader: str | None = self.me if self.single else None
         self.last_pulse = time.monotonic()
@@ -125,6 +134,9 @@ class Election:
         # in log order, with the entry's author and term so only the
         # reserving leader claims the window it committed
         self.adopt_seq_window = lambda start, end, by, term: None
+        # replicated filer shard map hook (MasterServer mirrors the
+        # applied map for /cluster/shards), called at APPLY time
+        self.adopt_shard_map = lambda epoch, shard_map: None
         self._http: aiohttp.ClientSession | None = None
         # frame fabric: one persistent multiplexed channel per raft
         # peer (HELLO identity signed with the cluster jwt key when
@@ -258,8 +270,25 @@ class Election:
                 self.adopt_seq_window(start, self.applied_seq,
                                       cmd.get("by", ""),
                                       int(entry.get("term", -1)))
+            sm = cmd.get("shard_map")
+            if sm is not None:
+                self._apply_shard_map(sm)
         self._maybe_snapshot()
         self._update_gauges()
+
+    def _apply_shard_map(self, sm: dict) -> None:
+        """Shard-map transition at APPLY time: a compare-and-swap on
+        the applied epoch. Like seq_reserve windows, the outcome is
+        decided by LOG ORDER, not by the proposer's view — a deposed
+        leader's proposal built on a stale epoch applies as a no-op,
+        so two leaders can never interleave conflicting maps."""
+        if int(sm.get("base", -1)) != self.applied_shard_epoch:
+            return
+        self.applied_shard_epoch += 1
+        m = dict(sm.get("map") or {})
+        m["epoch"] = self.applied_shard_epoch
+        self.applied_shard = m
+        self.adopt_shard_map(self.applied_shard_epoch, m)
 
     def _maybe_snapshot(self) -> None:
         """Log compaction (the reference's raft snapshot): fold applied
@@ -271,7 +300,9 @@ class Election:
         self.snap = {"last_index": self.applied,
                      "last_term": self._term_at(self.applied) or 0,
                      "value": self.applied_value,
-                     "seq": self.applied_seq}
+                     "seq": self.applied_seq,
+                     "shard_epoch": self.applied_shard_epoch,
+                     "shard_map": self.applied_shard}
         self.entries = self.entries[cut:]
         self._mark_dirty()
         glog.info("%s: snapshot at index %d (value %d, %d entries kept)",
@@ -405,7 +436,8 @@ class Election:
 
     def on_install_snapshot(self, term: int, leader: str, last_index: int,
                             last_term: int, value: int,
-                            seq: int = 0) -> dict:
+                            seq: int = 0, shard_epoch: int = 0,
+                            shard_map: dict | None = None) -> dict:
         """InstallSnapshot for followers whose log is behind the leader's
         compaction point."""
         if self.single or term < self.term:
@@ -423,7 +455,9 @@ class Election:
         self.last_pulse = time.monotonic()
         if last_index > self.last_index():
             self.snap = {"last_index": last_index, "last_term": last_term,
-                         "value": value, "seq": seq}
+                         "value": value, "seq": seq,
+                         "shard_epoch": shard_epoch,
+                         "shard_map": shard_map}
             self.entries = []
             self.commit = self.applied = last_index
             if value > self.applied_value:
@@ -434,6 +468,11 @@ class Election:
                 # so the installing node fences its counter past them
                 self.applied_seq = seq
                 self.adopt_seq_window(0, seq, "", -1)
+            if shard_epoch > self.applied_shard_epoch:
+                # folded shard-map transitions: adopt the compacted map
+                self.applied_shard_epoch = shard_epoch
+                self.applied_shard = shard_map
+                self.adopt_shard_map(shard_epoch, shard_map or {})
             self._mark_dirty()
         self._update_gauges()
         return {"term": self.term, "ok": True}
@@ -587,7 +626,10 @@ class Election:
                              "last_index": self.snap["last_index"],
                              "last_term": self.snap["last_term"],
                              "value": self.snap["value"],
-                             "seq": self.snap["seq"]})
+                             "seq": self.snap["seq"],
+                             "shard_epoch": self.snap.get(
+                                 "shard_epoch", 0),
+                             "shard_map": self.snap.get("shard_map")})
                     reply = await asyncio.wait_for(snap_rpc(),
                                                    self.attempt_timeout)
                     if reply.get("term", 0) > self.term:
@@ -684,6 +726,9 @@ class Election:
                 self.applied_seq = start + n
                 self.adopt_seq_window(start, self.applied_seq,
                                       cmd.get("by", ""), self.term)
+            sm = cmd.get("shard_map")
+            if sm is not None:
+                self._apply_shard_map(sm)
             return True
         # serialize command commits: two interleaved append_command
         # drivers would race the per-peer next/match bookkeeping (and

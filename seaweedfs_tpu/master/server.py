@@ -110,6 +110,10 @@ class MasterServer:
         self._tasks: list[asyncio.Task] = []
         self._http: aiohttp.ClientSession | None = None
         self._grow_lock = asyncio.Lock()
+        # applied filer shard map mirror (filer/shard.py): fed by the
+        # election's adopt hook at APPLY time; served on /cluster/shards
+        self.shard_epoch = 0
+        self.shard_map: dict | None = None
         # autopilot maintenance plane (autopilot/): the object always
         # exists so POST /debug/autopilot?run=1 can force a cycle even
         # with the loop disabled; the loop itself is leader-only and
@@ -192,6 +196,8 @@ class MasterServer:
         app.router.add_get("/cluster/watch", self.h_watch)
         app.router.add_get("/cluster/seq_lease", self.h_seq_lease)
         app.router.add_get("/cluster/assign_state", self.h_assign_state)
+        app.router.add_route("*", "/cluster/shards", self.h_cluster_shards)
+        app.router.add_get("/debug/shards", self.h_debug_shards)
         app.router.add_get("/stats/health", self.h_health)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_route("*", "/debug/failpoints",
@@ -369,6 +375,10 @@ class MasterServer:
             jwt_key=self.jwt_key))
         self.election.get_max_volume_id = lambda: self.topo.max_volume_id
         self.election.adopt_max_volume_id = self._adopt_max_volume_id
+        self.election.adopt_shard_map = self._adopt_shard_map
+        # replayed raft state may already hold a committed map
+        self.shard_epoch = self.election.applied_shard_epoch
+        self.shard_map = self.election.applied_shard
         if self._peers and not isinstance(self.seq, RaftSequencer):
             # multi-master: every fid block must come out of a
             # quorum-committed reservation window — the raft log is
@@ -427,9 +437,93 @@ class MasterServer:
         r = self.election.on_install_snapshot(
             int(body["term"]), body["leader"], int(body["last_index"]),
             int(body["last_term"]), int(body.get("value", 0)),
-            seq=int(body.get("seq", 0)))
+            seq=int(body.get("seq", 0)),
+            shard_epoch=int(body.get("shard_epoch", 0)),
+            shard_map=body.get("shard_map"))
         await self.election.flush()   # term bump / snapshot durable
         return web.json_response(r)
+
+    # ---- filer shard map (filer/shard.py) ----
+
+    def _adopt_shard_map(self, epoch: int, shard_map: dict) -> None:
+        """APPLY-time hook: mirror the committed map for serving."""
+        if epoch > self.shard_epoch:
+            self.shard_epoch = epoch
+            self.shard_map = shard_map
+
+    def _shard_map_dict(self) -> dict:
+        from ..filer.shard import ShardMap
+        if self.shard_map is not None:
+            return dict(self.shard_map, epoch=self.shard_epoch)
+        return ShardMap(epoch=self.shard_epoch).to_dict()
+
+    async def h_cluster_shards(self, req: web.Request) -> web.Response:
+        """GET: the applied shard map (any node serves its own applied
+        copy — a stale follower answer only costs the client one
+        redirect chase). POST: a map transition, leader-only, raft-
+        committed under an epoch CAS so a deposed leader's proposal
+        applies as a no-op."""
+        if req.method in ("GET", "HEAD"):
+            return web.json_response(dict(
+                self._shard_map_dict(), leader=self.leader_url or ""))
+        if (err := self._raft_unready()) is not None:
+            return err
+        if not self.is_leader:
+            return self._redirect_to_leader(req)
+        from ..filer.shard import ShardMap, apply_map_op
+        op = await req.json()
+        for _ in range(5):
+            base = self.shard_epoch
+            cur = ShardMap.from_dict(self._shard_map_dict())
+            try:
+                want = apply_map_op(cur, op)
+            except ValueError as e:
+                return web.json_response({"error": str(e)}, status=400)
+            committed = await self.election.append_command(
+                {"shard_map": {"base": base, "map": want.to_dict()},
+                 "by": self.url})
+            if not committed:
+                return web.json_response(
+                    {"error": "not leader / no quorum"}, status=503)
+            # CAS verdict: the op is in iff re-applying it to the NOW
+            # applied map is a no-op (every transition is idempotent)
+            applied = ShardMap.from_dict(self._shard_map_dict())
+            try:
+                again = apply_map_op(applied, op)
+            except ValueError:
+                # e.g. commit_move whose move already completed: the
+                # op's effect is behind us either way
+                again = applied
+            if again.to_dict() == applied.to_dict():
+                return web.json_response({"ok": True,
+                                          "map": applied.to_dict()})
+        return web.json_response(
+            {"error": "shard map CAS kept losing"}, status=503)
+
+    async def h_debug_shards(self, req: web.Request) -> web.Response:
+        """Merged fleet view: the committed map plus each owner
+        filer's local /__debug__/shards (entry counts, move state,
+        routing counters). A dead filer degrades its row, not the
+        endpoint."""
+        m = self._shard_map_dict()
+        shards = []
+        for sid_s, owner in sorted((m.get("owners") or {}).items(),
+                                   key=lambda kv: int(kv[0])):
+            row = {"shard": int(sid_s), "url": owner}
+            try:
+                # chaos site: the fan-out hop is routed traffic too
+                await failpoints.fail("filer.shard.route")
+                async with self._http.get(
+                        tls.url(owner, "/__debug__/shards"),
+                        timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    row.update(await resp.json())
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    OSError, AttributeError) as e:
+                row["error"] = str(e) or type(e).__name__
+            shards.append(row)
+        return web.json_response(
+            {"epoch": self.shard_epoch, "leader": self.leader_url or "",
+             "map": m, "shards": shards})
 
     def _leader_or_503(self) -> tuple[str | None, web.Response | None]:
         """Resolve the current leader, or the 503 every non-leader

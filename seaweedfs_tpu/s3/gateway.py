@@ -82,10 +82,16 @@ class S3Gateway:
                  domain_name: str = "",
                  cache_mem_bytes: int = 0,
                  cache_dir: str = "",
-                 admission=None):
+                 admission=None,
+                 shard_router=None):
         # -cache.mem/-cache.dir chunk read cache (see FilerServer)
         self.cache_mem_bytes = cache_mem_bytes
         self.cache_dir = cache_dir
+        # sharded gateway fleet (filer/shard.py GatewayRouter): one
+        # gateway per filer shard; foreign-bucket requests bounce to
+        # the sibling with 307 + X-Shard-Owner
+        self.shard_router = shard_router
+        self._shard_http: aiohttp.ClientSession | None = None
         # -domainName (s3api_server.go:35-37): virtual-host-style
         # addressing, Host: <bucket>.<domainName>
         self.domain_name = domain_name
@@ -150,6 +156,21 @@ class S3Gateway:
         # served unsigned, exactly like every other tier's /debug
         # surface (the bucket-shadowing caveat above already applies)
         debug = req.path.startswith("/__debug__")
+        if self.shard_router is not None and not debug \
+                and req.path != "/":
+            owner = await self.shard_router.foreign_owner(
+                self._shard_http, BUCKETS_DIR + req.path)
+            if owner:
+                self.shard_router.redirects += 1
+                return web.Response(
+                    status=307,
+                    headers={"Location": tls.url(owner, req.path_qs),
+                             "X-Shard-Owner": owner,
+                             "X-Shard-Prefix":
+                                 self.shard_router.matched_prefix(
+                                     BUCKETS_DIR + req.path),
+                             "X-Shard-Epoch": str(
+                                 self.shard_router.routes.map.epoch)})
         if self.identities and not debug:
             try:
                 # raw_path: SigV4 signs the encoded form verbatim, and a
@@ -215,6 +236,9 @@ class S3Gateway:
                                          disk_dir=self.cache_dir or None))
         self.client = WeedClient(self.master_url, chunk_cache=cc)
         await self.client.__aenter__()
+        if self.shard_router is not None:
+            self._shard_http = tls.make_session(
+                timeout=aiohttp.ClientTimeout(total=10))
         # when standalone (no colocated FilerServer draining chunk GC),
         # run our own drain loop so deletes/overwrites reclaim blobs
         self._gc_task: asyncio.Task | None = None
@@ -242,6 +266,8 @@ class S3Gateway:
     async def stop(self) -> None:
         if self._gc_task:
             self._gc_task.cancel()
+        if self._shard_http is not None:
+            await self._shard_http.close()
         if self.client:
             await self.client.__aexit__()
         if self._runner:

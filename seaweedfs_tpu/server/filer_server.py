@@ -21,6 +21,7 @@ from ..filer.stream import stream_chunk_views
 from ..storage import types as t
 from ..util.client import OperationError, WeedClient
 from ..util.httprange import RangeError, parse_range
+from ..util.singleflight import SingleFlight
 from ..security import tls
 
 
@@ -34,7 +35,10 @@ class FilerServer:
                  disable_dir_listing: bool = False,
                  dir_list_limit: int = 100_000,
                  cache_mem_bytes: int = 0,
-                 cache_dir: str = ""):
+                 cache_dir: str = "",
+                 shard_id: int = 0, shard_of: int = 1,
+                 shard_peers: dict | None = None,
+                 shard_split_mbps: float = 8.0):
         # -cache.mem/-cache.dir: tiered whole-chunk read cache riding
         # the WeedClient (util/chunk_cache); 0 disables
         self.cache_mem_bytes = cache_mem_bytes
@@ -54,6 +58,21 @@ class FilerServer:
         self._runner: web.AppRunner | None = None
         self._tasks: list[asyncio.Task] = []
         self.client: WeedClient | None = None
+        # -shard.id/-shard.of: this process owns a prefix range of the
+        # namespace per the raft-committed shard map (filer/shard.py)
+        self.shard = None
+        if shard_of > 1:
+            from ..filer.shard import ShardNode
+            self.shard = ShardNode(self, shard_id, shard_of,
+                                   peers=shard_peers,
+                                   split_mbps=shard_split_mbps)
+        # hot-listing collapse: identical concurrent list ops on one
+        # directory share a single store query, fenced by a per-dir
+        # generation the write listener bumps (util/singleflight.py)
+        self._list_sf = SingleFlight()
+        self._dir_gens: dict[str, int] = {}
+        self._fence_epoch = 0
+        self.filer.listeners.append(self._on_entry_change)
         self.app = self._build_app()
 
     def _build_app(self) -> web.Application:
@@ -118,6 +137,7 @@ class FilerServer:
             ("POST", "/__api__/entry", self.h_api_create_entry),
             ("POST", "/__api__/assign", self.h_api_assign),
             ("POST", "/__api__/delete", self.h_api_delete),
+            ("POST", "/__api__/shard/ingest", self.h_shard_ingest),
         ]
         for method, path, handler in api:
             app.router.add_route(method, path, handler)
@@ -140,6 +160,7 @@ class FilerServer:
         app.router.add_get("/__debug__/health", h_hl)
         from .. import qos
         app.router.add_get("/__debug__/qos", qos.debug_handler)
+        app.router.add_get("/__debug__/shards", self.h_debug_shards)
         # reserved-prefix path (like /__api__, /__debug__) so a stored
         # file named /metrics is never shadowed; exposes the chunk-cache
         # hit/miss/byte counters among the rest of the registry
@@ -188,8 +209,12 @@ class FilerServer:
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]
         self._tasks.append(asyncio.create_task(self._chunk_gc_loop()))
+        if self.shard is not None:
+            await self.shard.start()
 
     async def stop(self) -> None:
+        if self.shard is not None:
+            await self.shard.stop()
         for t in self._tasks:
             t.cancel()
         mc = getattr(self, "master_client", None)
@@ -232,11 +257,133 @@ class FilerServer:
             p = p.replace("//", "/")
         return p if p == "/" else p.rstrip("/")
 
+    # ---- shard ownership (filer/shard.py) ----
+
+    async def _shard_gate(self, req: web.Request,
+                          path: str) -> web.Response | None:
+        """Ownership enforcement: a request for a path this shard does
+        not own bounces with ``307 + X-Shard-Owner/-Prefix/-Epoch`` so
+        the client folds the owner into its route cache (the learned-
+        leader discipline). ``local=1`` marks a peer-internal hop that
+        must be answered from the local store, never re-routed."""
+        if self.shard is None or req.query.get("local") == "1":
+            return None
+        from ..util import failpoints
+        # chaos site: the per-request routing decision
+        await failpoints.fail("filer.shard.route")
+        if self.shard.is_local(path):
+            self.shard.counters["local"] += 1
+            from ..stats import metrics
+            if metrics.HAVE_PROMETHEUS:
+                metrics.FILER_SHARD_REQUESTS.labels("local").inc()
+            return None
+        hdrs = self.shard.redirect_headers(path)
+        if hdrs is None:
+            # owner unknown (map still syncing): 503 so the client
+            # retries — a routed miss must never read as a 404
+            return web.json_response(
+                {"error": "shard owner unknown", "path": path},
+                status=503, headers={"Retry-After": "1"})
+        loc = tls.url(hdrs["X-Shard-Owner"], req.path_qs)
+        return web.json_response(
+            {"error": "wrong shard", "owner": hdrs["X-Shard-Owner"]},
+            status=307, headers=dict(hdrs, Location=loc))
+
+    def _on_entry_change(self, old_entry, new_entry) -> None:
+        """Write listener: bump the listing generation of every parent
+        directory a mutation touches (the singleflight fill-token
+        fence — an in-flight collapsed fill keyed on the old
+        generation can no longer satisfy new readers)."""
+        for e in (old_entry, new_entry):
+            if e is not None:
+                self.bump_gen_fence(e.dir_path)
+
+    def bump_gen_fence(self, dir_path: str, subtree: bool = False) -> None:
+        d = dir_path or "/"
+        self._dir_gens[d] = self._dir_gens.get(d, 0) + 1
+        if subtree or len(self._dir_gens) > 8192:
+            # wholesale invalidation: subtree tombstone/migration, or
+            # the per-dir table growing without bound
+            self._dir_gens.clear()
+            self._fence_epoch += 1
+
+    async def _list_entries(self, path: str, start_file: str,
+                            inclusive: bool, limit: int) -> list[Entry]:
+        """One directory page: singleflight-collapsed, store query off
+        the event loop, merged across shards owning rules below the
+        directory when sharded."""
+        gen = self._dir_gens.get(path, 0)
+        key = (f"{path}|{start_file}|{int(inclusive)}|{limit}"
+               f"|{gen}|{self._fence_epoch}")
+
+        async def fill() -> list[Entry]:
+            if self.shard is not None:
+                self.shard.counters["local"] += 1
+                return await self.shard.merged_list(
+                    path, start_file, inclusive, limit)
+            from ..util import tracing
+            return await tracing.run_in_executor(
+                lambda: self.filer.list_directory_entries(
+                    path, start_file, inclusive, limit))
+
+        return await self._list_sf.do(key, fill)
+
+    async def _shard_fallback_entry(self, path: str) -> Entry | None:
+        """Local miss during a split's cleanup window: double-read the
+        old owner (it holds entries not yet streamed over) so the
+        migration window never surfaces a 404."""
+        if self.shard is None:
+            return None
+        src = self.shard.double_read_source(path)
+        if not src:
+            return None
+        d = await self.shard.forward_lookup(src, path)
+        if d is None:
+            return None
+        from ..filer.shard import _entry_from_json
+        return _entry_from_json(d)
+
+    async def h_shard_ingest(self, req: web.Request) -> web.Response:
+        """Migration sink for split/move batches (idempotent,
+        mtime-gated; see ShardNode.ingest)."""
+        if self.shard is None:
+            return web.json_response(
+                {"error": "not a sharded filer"}, status=400)
+        body = await req.json()
+        n = await self.shard.ingest(body.get("entries", []))
+        if int(body.get("epoch") or 0) > self.shard.map.epoch:
+            await self.shard.adopt_epoch(int(body["epoch"]))
+        # a migrated batch changes listings wholesale under the moved
+        # prefix: drop every collapsed fill
+        self.bump_gen_fence("/", subtree=True)
+        from ..stats import metrics
+        if metrics.HAVE_PROMETHEUS:
+            metrics.FILER_SHARD_REQUESTS.labels("ingest").inc()
+        return web.json_response({"ingested": n})
+
+    async def h_debug_shards(self, req: web.Request) -> web.Response:
+        if self.shard is not None:
+            st = self.shard.status()
+        else:
+            count = getattr(self.filer.store, "count_entries", None)
+            st = {"shard": 0, "of": 1, "url": self.url, "epoch": 0,
+                  "entries": count() if count is not None else -1,
+                  "rules": [["/", 0]], "owners": {},
+                  "moves": [], "counters": {}}
+        st["singleflight"] = {"calls": self._list_sf.calls,
+                              "collapsed": self._list_sf.collapsed}
+        return web.json_response(st)
+
     # ---- read path ----
 
     async def h_get(self, req: web.Request) -> web.StreamResponse:
         path = self._path(req)
+        bounce = await self._shard_gate(req, path)
+        if bounce is not None:
+            return bounce
         entry = self.filer.find_entry(path)
+        if entry is None:
+            entry = await self._shard_fallback_entry(path)
         if entry is None:
             return web.json_response({"error": "not found"}, status=404)
         if entry.is_directory:
@@ -322,7 +469,7 @@ class FilerServer:
             limit = 1000
         limit = min(limit, self.dir_list_limit)
         last = req.query.get("lastFileName", "")
-        entries = self.filer.list_directory_entries(path, last, False, limit)
+        entries = await self._list_entries(path, last, False, limit)
         return web.json_response({
             "Path": path,
             "Entries": [self._entry_json(e) for e in entries],
@@ -347,11 +494,10 @@ class FilerServer:
     async def h_post(self, req: web.Request) -> web.Response:
         path = self._path(req)
         if "mv.from" in req.query:
-            try:
-                self.filer.rename_entry(req.query["mv.from"], path)
-            except FilerError as e:
-                return web.json_response({"error": str(e)}, status=400)
-            return web.json_response({"ok": True})
+            return await self._rename(req, req.query["mv.from"], path)
+        bounce = await self._shard_gate(req, path)
+        if bounce is not None:
+            return bounce
         raw_path = req.match_info["path"]
         if (raw_path.endswith("/") and raw_path != "") \
                 or req.query.get("mkdir") == "true":
@@ -430,8 +576,45 @@ class FilerServer:
         return web.json_response(
             {"name": filename or entry.name, "size": offset}, status=201)
 
+    async def _rename(self, req: web.Request, src: str,
+                      dst: str) -> web.Response:
+        """Rename, shard-aware: the SOURCE shard drives. Same-shard
+        renames stay the plain atomic store move; a cross-shard rename
+        runs as a raft-journaled two-phase move (intent committed,
+        copy-then-tombstone, idempotent replay on crash)."""
+        if self.shard is not None and req.query.get("local") != "1":
+            from ..util import failpoints
+            # chaos site: the rename routing decision
+            await failpoints.fail("filer.shard.route")
+            if not self.shard.is_local(src):
+                hdrs = self.shard.redirect_headers(src)
+                if hdrs is None:
+                    return web.json_response(
+                        {"error": "shard owner unknown", "path": src},
+                        status=503, headers={"Retry-After": "1"})
+                loc = tls.url(hdrs["X-Shard-Owner"], req.path_qs)
+                return web.json_response(
+                    {"error": "wrong shard",
+                     "owner": hdrs["X-Shard-Owner"]},
+                    status=307, headers=dict(hdrs, Location=loc))
+            if not self.shard.is_local(dst):
+                try:
+                    await self.shard.cross_shard_rename(src, dst)
+                except (OSError, ValueError) as e:
+                    return web.json_response({"error": str(e)},
+                                             status=409)
+                return web.json_response({"ok": True, "moved": True})
+        try:
+            self.filer.rename_entry(src, dst)
+        except FilerError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response({"ok": True})
+
     async def h_delete(self, req: web.Request) -> web.Response:
         path = self._path(req)
+        bounce = await self._shard_gate(req, path)
+        if bounce is not None:
+            return bounce
         recursive = req.query.get("recursive") == "true"
         try:
             self.filer.delete_entry(path, recursive=recursive,
@@ -445,21 +628,45 @@ class FilerServer:
     # ---- metadata API (filer.proto analog) ----
 
     async def h_api_lookup(self, req: web.Request) -> web.Response:
-        entry = self.filer.find_entry(req.query["path"])
+        path = req.query["path"]
+        bounce = await self._shard_gate(req, path)
+        if bounce is not None:
+            return bounce
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            entry = await self._shard_fallback_entry(path)
         if entry is None:
             return web.json_response({"error": "not found"}, status=404)
         return web.json_response(self._entry_json(entry))
 
     async def h_api_list(self, req: web.Request) -> web.Response:
-        entries = self.filer.list_directory_entries(
-            req.query["path"], req.query.get("startFile", ""),
-            req.query.get("inclusive") == "true",
-            int(req.query.get("limit", 1024)))
+        path = req.query["path"]
+        bounce = await self._shard_gate(req, path)
+        if bounce is not None:
+            return bounce
+        limit = int(req.query.get("limit", 1024))
+        if limit <= 0:
+            # same clamp as _list_dir: SQLite reads LIMIT -1 as
+            # unlimited, so a negative value must not bypass the cap
+            limit = 1000
+        limit = min(limit, self.dir_list_limit)
+        if req.query.get("local") == "1":
+            # peer-internal hop of a merged listing: local page only
+            entries = self.filer.list_directory_entries(
+                path, req.query.get("startFile", ""),
+                req.query.get("inclusive") == "true", limit)
+        else:
+            entries = await self._list_entries(
+                path, req.query.get("startFile", ""),
+                req.query.get("inclusive") == "true", limit)
         return web.json_response(
             {"entries": [self._entry_json(e) for e in entries]})
 
     async def h_api_create_entry(self, req: web.Request) -> web.Response:
         body = await req.json()
+        bounce = await self._shard_gate(req, body.get("FullPath", "/"))
+        if bounce is not None:
+            return bounce
         e = Entry(
             full_path=body["FullPath"],
             attr=Attr(mtime=body.get("Mtime", time.time()),
@@ -479,11 +686,8 @@ class FilerServer:
         return web.json_response({"ok": True})
 
     async def h_api_rename(self, req: web.Request) -> web.Response:
-        try:
-            self.filer.rename_entry(req.query["from"], req.query["to"])
-        except FilerError as e:
-            return web.json_response({"error": str(e)}, status=400)
-        return web.json_response({"ok": True})
+        return await self._rename(req, req.query["from"],
+                                  req.query["to"])
 
     async def h_api_assign(self, req: web.Request) -> web.Response:
         try:

@@ -46,7 +46,13 @@ class WebDavServer:
                  chunk_size: int = 16 * 1024 * 1024,
                  jwt_key: str = "",
                  cache_mem_bytes: int = 0,
-                 cache_dir: str = ""):
+                 cache_dir: str = "",
+                 shard_router=None):
+        # sharded gateway fleet (filer/shard.py GatewayRouter): the
+        # WebDAV namespace IS the filer namespace, so foreign paths
+        # bounce straight to the owning sibling
+        self.shard_router = shard_router
+        self._shard_http = None
         self.filer = filer
         self.master_url = master_url
         self.ip = ip
@@ -84,6 +90,10 @@ class WebDavServer:
 
     async def start(self) -> None:
         await self.client.__aenter__()
+        if self.shard_router is not None:
+            import aiohttp
+            self._shard_http = tls.make_session(
+                timeout=aiohttp.ClientTimeout(total=10))
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.ip, self.port)
@@ -95,6 +105,8 @@ class WebDavServer:
     async def stop(self) -> None:
         for t in self._tasks:
             t.cancel()
+        if self._shard_http is not None:
+            await self._shard_http.close()
         await self.client.__aexit__(None, None, None)
         if self._runner:
             await self._runner.cleanup()
@@ -142,6 +154,20 @@ class WebDavServer:
                           "/__debug__/events": h_ev,
                           "/__debug__/health": h_hl,
                           "/__debug__/qos": qos.debug_handler}[path](req)
+        if self.shard_router is not None \
+                and not path.startswith("/__debug__"):
+            owner = await self.shard_router.foreign_owner(
+                self._shard_http, path)
+            if owner:
+                self.shard_router.redirects += 1
+                return web.Response(
+                    status=307,
+                    headers={"Location": tls.url(owner, req.path_qs),
+                             "X-Shard-Owner": owner,
+                             "X-Shard-Prefix":
+                                 self.shard_router.matched_prefix(path),
+                             "X-Shard-Epoch": str(
+                                 self.shard_router.routes.map.epoch)})
         handler = getattr(self, f"h_{req.method.lower()}", None)
         if handler is None:
             return web.Response(status=405)

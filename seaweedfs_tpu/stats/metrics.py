@@ -58,6 +58,20 @@ if HAVE_PROMETHEUS:
     FILER_REQUEST_TIME = Histogram(
         "SeaweedFS_filer_request_seconds", "filer request time",
         ["type"], registry=REGISTRY)
+    # sharded filer metadata plane (filer/shard.py): routing outcomes
+    # per request, the committed map epoch this shard has adopted, and
+    # entries streamed out by split/move migrations
+    FILER_SHARD_REQUESTS = Counter(
+        "SeaweedFS_filer_shard_requests_total",
+        "shard routing outcomes",
+        ["result"], registry=REGISTRY)
+    FILER_SHARD_EPOCH = Gauge(
+        "SeaweedFS_filer_shard_map_epoch",
+        "adopted shard map epoch", registry=REGISTRY)
+    FILER_SHARD_MOVED = Counter(
+        "SeaweedFS_filer_shard_moved_entries_total",
+        "entries migrated out by shard split/move",
+        registry=REGISTRY)
     EC_ENCODE_BYTES = Counter(
         "SeaweedFS_ec_encode_bytes_total", "bytes erasure-encoded",
         registry=REGISTRY)
@@ -339,6 +353,9 @@ NON_ADDITIVE_GAUGE_PREFIXES = (
     # across a merged host would report 2 leaders the moment any two
     # workers each said "1" — the host's honest answer is the max
     "SeaweedFS_raft_",
+    # the adopted shard-map epoch is likewise an identity, not a
+    # quantity — a merged host answers with the furthest-along worker
+    "SeaweedFS_filer_shard_map_epoch",
 )
 _NON_ADDITIVE_B = tuple(p.encode() for p in NON_ADDITIVE_GAUGE_PREFIXES)
 

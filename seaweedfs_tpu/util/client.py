@@ -1021,3 +1021,129 @@ class WeedClient:
             for fid in fids:
                 self.chunk_cache.delete(fid)
         return sum(counts)
+
+
+class FilerHttpClient:
+    """Shard-routing client for the filer metadata surface.
+
+    Routes each path by longest-prefix match against the cached shard
+    map, chases ``307 + X-Shard-Owner`` answers (bounded hops) and
+    folds the learned owner into the route cache — the same learned-
+    leader rotation discipline ``WeedClient._master_get`` applies to
+    raft leadership. Used by tools/bench_meta.py and the meta soak;
+    collapses to a plain filer client at 1 shard.
+    """
+
+    MAX_HOPS = 4
+
+    def __init__(self, filers: list[str] | str, master_url: str = "",
+                 timeout_s: float = 30.0):
+        from ..filer.shard import RouteCache
+        if isinstance(filers, str):
+            filers = [f.strip() for f in filers.split(",") if f.strip()]
+        if not filers:
+            raise ValueError("FilerHttpClient needs at least one filer")
+        self.filers = filers
+        self.routes = RouteCache(master_url)
+        self.timeout_s = timeout_s
+        self.redirects_chased = 0
+        self.session: aiohttp.ClientSession | None = None
+
+    async def __aenter__(self) -> "FilerHttpClient":
+        self.session = tls.make_session(
+            timeout=aiohttp.ClientTimeout(total=self.timeout_s))
+        if self.routes.master_seeds:
+            await self.routes.refresh(self.session, force=True)
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self.session is not None:
+            await self.session.close()
+
+    def _first_base(self, route_path: str) -> str:
+        return self.routes.owner_for(route_path) or self.filers[0]
+
+    async def request(self, method: str, path: str,
+                      route_path: str | None = None,
+                      params: dict | None = None,
+                      data: bytes | None = None,
+                      expect: tuple = (200, 201, 204)) -> dict:
+        """One routed filer call. `route_path` is the namespace path
+        the shard map routes on (defaults to `path` — pass it
+        explicitly for /__api__/ calls whose URL is not the entry
+        path). Raises OperationError on a non-`expect` terminal
+        answer."""
+        rp = route_path if route_path is not None else path
+        base = self._first_base(rp)
+        body: dict = {}
+        for _ in range(self.MAX_HOPS):
+            # chaos site: every routed metadata hop
+            await failpoints.fail("filer.shard.route")
+            async with self.session.request(
+                    method, tls.url(base, path), params=params,
+                    data=data, allow_redirects=False) as resp:
+                if resp.status in (307, 302):
+                    owner = resp.headers.get("X-Shard-Owner", "")
+                    if not owner:
+                        raise OperationError(
+                            f"{method} {path}: redirect without "
+                            f"X-Shard-Owner from {base}")
+                    self.routes.learn(
+                        resp.headers.get("X-Shard-Prefix", rp), owner,
+                        int(resp.headers.get("X-Shard-Epoch", 0) or 0))
+                    self.redirects_chased += 1
+                    base = owner
+                    continue
+                if resp.content_type == "application/json":
+                    body = await resp.json()
+                else:
+                    body = {"raw": await resp.read()}
+                if resp.status == 503 and self.routes.master_seeds:
+                    # owner unknown on that shard: refetch the map
+                    # and retry (split window, registration race)
+                    await asyncio.sleep(0.1)
+                    await self.routes.refresh(self.session, force=True)
+                    base = self._first_base(rp)
+                    continue
+                if resp.status not in expect:
+                    raise OperationError(
+                        f"{method} {path} -> {resp.status}: "
+                        f"{body.get('error', '')}")
+                return body
+        raise OperationError(f"{method} {path}: shard redirect loop "
+                             f"(> {self.MAX_HOPS} hops)")
+
+    # -- the metadata ops the benchmarks drive -------------------------
+
+    async def create(self, path: str, payload: bytes = b"x") -> dict:
+        return await self.request("PUT", path, data=payload,
+                                  expect=(201,))
+
+    async def mkdir(self, path: str) -> dict:
+        return await self.request("POST", path, params={"mkdir": "true"},
+                                  expect=(201,))
+
+    async def stat(self, path: str) -> dict:
+        return await self.request("GET", "/__api__/lookup",
+                                  route_path=path,
+                                  params={"path": path})
+
+    async def list_dir(self, path: str, start_file: str = "",
+                       limit: int = 1024,
+                       inclusive: bool = False) -> list[dict]:
+        body = await self.request(
+            "GET", "/__api__/list", route_path=path,
+            params={"path": path, "startFile": start_file,
+                    "inclusive": "true" if inclusive else "false",
+                    "limit": str(limit)})
+        return body.get("entries", [])
+
+    async def rename(self, src: str, dst: str) -> dict:
+        return await self.request("POST", "/__api__/rename",
+                                  route_path=src,
+                                  params={"from": src, "to": dst})
+
+    async def delete(self, path: str, recursive: bool = False) -> dict:
+        return await self.request(
+            "DELETE", path,
+            params={"recursive": "true"} if recursive else None)

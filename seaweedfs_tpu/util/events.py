@@ -77,6 +77,11 @@ TYPES = frozenset({
     "arbiter_yield",            # the bandwidth arbiter squeezed a
                                 # background consumer below its base
                                 # rate under foreground pressure
+    "shard_split",              # filer shard split phase transition
+                                # (flip = routing cut over in one raft
+                                # apply; done = tombstone complete)
+    "shard_move",               # cross-shard rename phase transition
+                                # of the journaled two-phase move
 })
 
 _MAX_FIELDS = 16                # per-event field cap (bounded memory)

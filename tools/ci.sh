@@ -125,6 +125,18 @@ if ! timeout -k 10 240 env JAX_PLATFORMS=cpu python tools/soak.py qos --quick; t
     exit 1
 fi
 
+echo "== meta smoke (soak meta --quick: sharded filer QPS + split under chaos) =="
+if ! timeout -k 10 480 env JAX_PLATFORMS=cpu python tools/soak.py meta --quick; then
+    echo "meta smoke: FAILED (sharded filer metadata plane regression —"
+    echo "op-accounted aggregate QPS must scale >= 3x at 4 shards with"
+    echo "local-serve counters proving routing, an online split must"
+    echo "survive armed filer.shard.* failpoints plus a SIGKILL of the"
+    echo "source filer by replaying the raft-committed move journal, and"
+    echo "the final paged enumeration must hold every entry exactly"
+    echo "once; see output above)"
+    exit 1
+fi
+
 echo "== tier-1 tests =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider \

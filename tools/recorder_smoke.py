@@ -270,6 +270,111 @@ def main() -> int:
         check(obs["nodes"] >= 1, "autopilot observed no nodes")
         print(f"  autopilot: dry-run cycle over {obs['nodes']} nodes, "
               f"{len(forced['cycle']['planned'])} planned")
+        # -- sharded filer plane (map, redirect hints, debug, events) ---
+        import http.client
+        f0, f1 = f"127.0.0.1:{PORT + 10}", f"127.0.0.1:{PORT + 11}"
+        for sid, fp in ((0, PORT + 10), (1, PORT + 11)):
+            spawn("filer", "-port", str(fp), "-ip", "127.0.0.1",
+                  "-master", master, "-store", "sqlite",
+                  "-dbPath", os.path.join(tmp, f"f{sid}.db"),
+                  "-shard.id", str(sid), "-shard.of", "2",
+                  "-shard.peers", f"{f0},{f1}")
+        for _ in range(60):
+            try:
+                if {"0", "1"} <= set(get_json(
+                        master, "/cluster/shards").get("owners", {})):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.5)
+        else:
+            raise AssertionError("filer shards never registered")
+        req = urllib.request.Request(
+            f"http://{master}/cluster/shards",
+            data=json.dumps({"op": "set", "rules":
+                             [["/", 0], ["/shard/t", 1]]}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            check(r.status == 200, f"shard map set -> {r.status}")
+        # the foreign-path answer must carry the learnable hint trio
+        # (poll: shard 0 adopts the new rule on its ~2s map refresh)
+        rr = None
+        for _ in range(40):
+            c = http.client.HTTPConnection("127.0.0.1", PORT + 10,
+                                           timeout=10)
+            c.request("GET", "/__api__/lookup?path=/shard/t/x")
+            rr = c.getresponse()
+            rr.read()
+            if rr.status == 307:
+                break
+            time.sleep(0.5)
+        check(rr is not None and rr.status == 307,
+              f"foreign path not redirected "
+              f"(got {rr.status if rr else '?'})")
+        for h in ("X-Shard-Owner", "X-Shard-Prefix", "X-Shard-Epoch"):
+            check(rr.getheader(h), f"307 missing {h} hint header")
+        check(rr.getheader("X-Shard-Owner") == f1,
+              f"wrong owner hint {rr.getheader('X-Shard-Owner')!r}")
+        # a tiny real split: seed /shard/u on 0, move it to 1 — the
+        # journal must record the flip and the done phases
+        for i in range(3):
+            body = json.dumps({"FullPath": f"/shard/u/e{i}",
+                               "Mtime": 1.0 + i}).encode()
+            req = urllib.request.Request(
+                f"http://{f0}/__api__/entry", data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                check(r.status == 200, f"seed entry -> {r.status}")
+        req = urllib.request.Request(
+            f"http://{master}/cluster/shards",
+            data=json.dumps({"op": "split_intent",
+                             "prefix": "/shard/u", "to": 1}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            check(r.status == 200, f"split_intent -> {r.status}")
+        for _ in range(60):
+            if not get_json(master, "/cluster/shards").get("moves"):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("split never drained")
+        ds = get_json(f0, "/__debug__/shards")
+        for key in ("shard", "of", "url", "epoch", "entries", "rules",
+                    "owners", "moves", "counters", "singleflight"):
+            check(key in ds, f"/__debug__/shards missing {key!r}")
+        for key in ("local", "redirect", "forward", "merge", "ingest",
+                    "moved", "replayed"):
+            check(key in ds["counters"],
+                  f"shard counters missing {key!r}")
+        check(ds["counters"]["moved"] >= 3,
+              f"split moved {ds['counters']['moved']} < 3 entries")
+        md = get_json(master, "/debug/shards")
+        for key in ("epoch", "leader", "map", "shards"):
+            check(key in md, f"master /debug/shards missing {key!r}")
+        check(len(md["shards"]) == 2,
+              f"master fan-out saw {len(md['shards'])} shards")
+        sev = get_json(f0, "/__debug__/events?type=shard_split")
+        check(sev["events"], "no shard_split journal rows after the "
+                             "split")
+        for key in ("id", "phase", "shard", "seconds"):
+            check(key in sev["events"][0],
+                  f"shard_split row missing {key!r}")
+        phases = {e["phase"] for e in sev["events"]}
+        check({"flip", "done"} <= phases,
+              f"split phases incomplete (saw {sorted(phases)})")
+        with urllib.request.urlopen(f"http://{f0}/__metrics__",
+                                    timeout=10) as r:
+            mtext = r.read().decode()
+        for name in ("SeaweedFS_filer_shard_requests_total",
+                     "SeaweedFS_filer_shard_map_epoch",
+                     "SeaweedFS_filer_shard_moved_entries_total"):
+            check(name in mtext, f"{name} absent from filer metrics")
+        print(f"  shards: 307 hints + split journal (flip/done) + "
+              f"map epoch {ds['epoch']} + moved="
+              f"{ds['counters']['moved']} OK")
         print("recorder smoke: OK")
         return 0
     finally:

@@ -49,6 +49,17 @@ over real sockets, and byte-verifies every surviving file at the end.
                                        # acked write must read back
                                        # byte-identical (--quick: the
                                        # ci.sh smoke)
+    python tools/soak.py meta          # sharded filer metadata plane:
+                                       # >=3x op-accounted QPS at 4
+                                       # shards, an online split under
+                                       # armed filer.shard.* failpoints
+                                       # + a SIGKILL of the source filer
+                                       # (the journaled move must
+                                       # replay), a cross-shard rename
+                                       # storm with kills — the final
+                                       # paged enumeration must hold
+                                       # every entry exactly once
+                                       # (--quick: the ci.sh smoke)
     python tools/soak.py all
 
 Exit code 0 only when every read verifies.
@@ -1707,6 +1718,389 @@ async def scenario_heal(tmp: str) -> int:
         procs.kill_all()
 
 
+def _filer_failpoints(fport: int, method: str, query: str = "") -> None:
+    # the filer's path-shadowed admin surface lives under /__debug__/
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{fport}/__debug__/failpoints{query}",
+        method=method)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        r.read()
+
+
+def _shards_body(port: int, path: str, body: dict) -> dict:
+    import json as _json
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=_json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return _json.loads(r.read())
+
+
+async def scenario_meta(tmp: str) -> int:
+    """Sharded filer metadata plane acceptance (ISSUE 18). Three
+    phases:
+
+    1. QPS A/B — bench_meta.run_bench at 1 shard then 4; the
+       op-accounted aggregate (sum of per-shard SOLO rates, locality
+       proven by the routed counters) must scale >= 3x.
+    2. Online split under chaos — populate /soak/hot on shard 0, arm
+       filer.shard.* failpoints, commit a split_intent to shard 1,
+       SIGKILL the SOURCE filer while the journaled move is pending,
+       restart it and let the raft-committed intent replay to
+       completion. Foreground creates/stats/lists run the whole time
+       (retrying transient faults) and must end with ZERO given-up
+       ops; the final paged enumeration must match the expected
+       namespace exactly — no lost entry, no duplicate, mtimes
+       byte-identical — and the source shard's local copy of the
+       moved prefix must be fully tombstoned.
+    3. Cross-shard rename storm — journaled two-phase moves from
+       shard 0 into the split prefix on shard 1, with the source
+       filer SIGKILLed mid-storm; every dst must exist exactly once
+       with the src's mtime, every src must be gone.
+    """
+    import json as _json
+
+    import aiohttp
+
+    import bench_meta
+    from seaweedfs_tpu.util import failpoints as _fp
+    from seaweedfs_tpu.util.client import FilerHttpClient, OperationError
+    quick = "--quick" in sys.argv
+    failures = 0
+    port0 = BASE_PORT + 180
+
+    # -- phase 1: the >=3x op-accounted scaling gate -------------------
+    ab_ops = 100 if quick else 600
+    r1 = r4 = None
+    for n, off in ((1, 0), (4, 20)):
+        d = os.path.join(tmp, f"ab{n}")
+        await asyncio.to_thread(os.makedirs, d, exist_ok=True)
+        r = await bench_meta.run_bench(n, ab_ops, d,
+                                       base_port=port0 + off)
+        if n == 1:
+            r1 = r
+        else:
+            r4 = r
+    x = r4["aggregate_qps"] / max(r1["aggregate_qps"], 1e-9)
+    print(f"  A/B: 1-shard {r1['aggregate_qps']:.0f} QPS, 4-shard "
+          f"{r4['aggregate_qps']:.0f} QPS -> {x:.2f}x "
+          f"(storm_errors={r1['storm_errors']}+{r4['storm_errors']})")
+    if x < 3.0:
+        print("  FAIL: aggregate metadata QPS did not scale >= 3x")
+        failures += 1
+    if r1["storm_errors"] or r4["storm_errors"]:
+        print("  FAIL: errors under the concurrent storm")
+        failures += 1
+    for c in r4["counters"]:
+        # locality is the accounting's foundation: a shard serving
+        # redirects instead of local ops would inflate nothing
+        if c["local"] <= 0 or c["redirect"] * 20 > c["local"]:
+            print(f"  FAIL: shard {c['url']} not serving locally: {c}")
+            failures += 1
+
+    # -- phase 2: online split under failpoints + SIGKILL --------------
+    procs = Procs(tmp)
+    try:
+        port = port0 + 40
+        master = f"127.0.0.1:{port}"
+        fports = [port + 1, port + 2]
+        filers = [f"127.0.0.1:{p}" for p in fports]
+
+        async def spawn_filer(sid: int):
+            return await procs.spawn(
+                "filer", "-port", str(fports[sid]), "-ip", "127.0.0.1",
+                "-master", master, "-store", "sqlite",
+                "-dbPath", os.path.join(tmp, f"filer{sid}.db"),
+                "-shard.id", str(sid), "-shard.of", "2",
+                "-shard.peers", ",".join(filers),
+                "-shard.splitMbps", "0.05" if quick else "0.02")
+
+        await procs.spawn("master", "-port", str(port),
+                          "-ip", "127.0.0.1",
+                          "-mdir", os.path.join(tmp, "m"))
+        for sid in range(2):
+            await spawn_filer(sid)
+        for _ in range(120):
+            try:
+                m = _http_json(port, "/cluster/shards")
+                if {"0", "1"} <= set(m.get("owners", {})):
+                    break
+            except OSError:
+                pass
+            await asyncio.sleep(0.5)
+        else:
+            raise RuntimeError("filer shards never registered")
+
+        n_seed = 300 if quick else 800
+        expect: dict[str, float] = {}
+        async with FilerHttpClient(filers, master_url=master) as cli:
+            sem = asyncio.Semaphore(16)
+
+            async def seed(i: int) -> None:
+                p = f"/soak/hot/d{i % 10}/f{i:04d}"
+                mt = 1_700_000_000.0 + i
+                async with sem:
+                    await cli.request(
+                        "POST", "/__api__/entry", route_path=p,
+                        data=_json.dumps({"FullPath": p,
+                                          "Mtime": mt}).encode())
+                expect[p] = mt
+
+            await asyncio.gather(*(seed(i) for i in range(n_seed)))
+            print(f"  seeded {len(expect)} entries under /soak/hot "
+                  f"(shard 0)")
+
+            # chaos: migration batches on the source always fail (the
+            # move is guaranteed pending when the SIGKILL lands), the
+            # routed gate throws/stalls a slice of foreground hops,
+            # and the client's own hop site stalls in-process
+            _filer_failpoints(fports[0], "POST",
+                              "?site=filer.shard.split&spec=error")
+            for fp in fports:
+                _filer_failpoints(
+                    fp, "POST",
+                    "?site=filer.shard.route&spec=error@0.03")
+            _fp.arm("filer.shard.route", "latency=5@0.05")
+
+            # foreground load for the WHOLE split window: transient
+            # faults (injected 5xx, the dead-filer gap) are retried,
+            # an op that never lands within its deadline is a failure
+            stop = asyncio.Event()
+            fg = {"ok": 0, "retries": 0, "gaveup": 0, "seq": 0}
+
+            async def fg_op(kind: str, path: str, mt: float) -> bool:
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    try:
+                        if kind == "create":
+                            await cli.request(
+                                "POST", "/__api__/entry",
+                                route_path=path,
+                                data=_json.dumps(
+                                    {"FullPath": path,
+                                     "Mtime": mt}).encode())
+                        elif kind == "stat":
+                            await cli.stat(path)
+                        else:
+                            await cli.list_dir(path, limit=64)
+                        fg["ok"] += 1
+                        return True
+                    except (OperationError, aiohttp.ClientError,
+                            asyncio.TimeoutError, OSError):
+                        fg["retries"] += 1
+                        await asyncio.sleep(0.3)
+                fg["gaveup"] += 1
+                return False
+
+            async def fg_loop() -> None:
+                rng = random.Random(77)
+                while not stop.is_set():
+                    r = rng.random()
+                    if r < 0.5:
+                        fg["seq"] += 1
+                        p = f"/soak/hot/live/f{fg['seq']:05d}"
+                        mt = 1_750_000_000.0 + fg["seq"]
+                        if await fg_op("create", p, mt):
+                            expect[p] = mt
+                    elif r < 0.8 and expect:
+                        await fg_op("stat", rng.choice(
+                            sorted(expect)[:50]), 0)
+                    else:
+                        await fg_op("list",
+                                    f"/soak/hot/d{rng.randrange(10)}",
+                                    0)
+                    await asyncio.sleep(0.02)
+
+            fg_tasks = [asyncio.create_task(fg_loop())
+                        for _ in range(3)]
+
+            _shards_body(port, "/cluster/shards",
+                         {"op": "split_intent", "prefix": "/soak/hot",
+                          "to": 1})
+            await asyncio.sleep(2.0 if quick else 3.0)
+            m = _http_json(port, "/cluster/shards")
+            mv = [v for v in m.get("moves", ())
+                  if v["id"] == "split:/soak/hot"]
+            if not mv:
+                print("  FAIL: split intent not pending in the map")
+                failures += 1
+            print(f"  split committed, state="
+                  f"{mv[0]['state'] if mv else '?'}; SIGKILLing the "
+                  f"source filer with the move journaled")
+            procs.procs[1].send_signal(signal.SIGKILL)
+            await asyncio.sleep(1.0)
+            await spawn_filer(0)
+            # the restarted source replays the raft-committed intent;
+            # re-arm a moderate batch-failure rate so the replay
+            # itself retries through injected faults
+            for _ in range(60):
+                try:
+                    _filer_failpoints(
+                        fports[0], "POST",
+                        "?site=filer.shard.split&spec=error@0.2")
+                    break
+                except OSError:
+                    await asyncio.sleep(0.5)
+
+            deadline = time.monotonic() + (180 if quick else 300)
+            done = False
+            while time.monotonic() < deadline:
+                await asyncio.sleep(2)
+                try:
+                    m = _http_json(port, "/cluster/shards")
+                except OSError:
+                    continue
+                rules = {tuple(r) for r in m.get("rules", ())}
+                if not m.get("moves") and ("/soak/hot", 1) in rules:
+                    done = True
+                    break
+            if not done:
+                print("  FAIL: split never drained after the replay")
+                failures += 1
+            else:
+                print("  split replayed to completion: /soak/hot -> "
+                      "shard 1, moves empty")
+
+            stop.set()
+            await asyncio.gather(*fg_tasks)
+            print(f"  foreground: ok={fg['ok']} retries={fg['retries']}"
+                  f" gaveup={fg['gaveup']}")
+            failures += fg["gaveup"]
+
+            # -- phase 3: cross-shard rename storm with a kill ---------
+            n_ren = 12 if quick else 30
+            for i in range(n_ren):
+                p = f"/soak/ren/r{i:03d}"
+                await cli.request(
+                    "POST", "/__api__/entry", route_path=p,
+                    data=_json.dumps({"FullPath": p,
+                                      "Mtime": 1_800_000_000.0 + i
+                                      }).encode())
+
+            async def ren(i: int) -> bool:
+                src = f"/soak/ren/r{i:03d}"
+                dst = f"/soak/hot/m{i:03d}"
+                deadline = time.monotonic() + 90
+                while time.monotonic() < deadline:
+                    try:
+                        await cli.rename(src, dst)
+                        return True
+                    except (OperationError, aiohttp.ClientError,
+                            asyncio.TimeoutError, OSError):
+                        try:
+                            await cli.stat(dst)
+                            try:
+                                await cli.stat(src)
+                            except OperationError:
+                                return True      # move replayed through
+                        except (OperationError, aiohttp.ClientError,
+                                asyncio.TimeoutError, OSError):
+                            pass  # dst not there yet: retry until the
+                            # deadline; a stuck move fails below
+                        await asyncio.sleep(0.5)
+                return False
+
+            async def ren_batch(lo: int, hi: int) -> int:
+                res = await asyncio.gather(*(ren(i)
+                                             for i in range(lo, hi)))
+                return sum(0 if ok else 1 for ok in res)
+
+            bad_ren = await ren_batch(0, n_ren // 3)
+            procs.procs[-1].send_signal(signal.SIGKILL)
+            print("  SIGKILLed the rename source filer mid-storm")
+            await asyncio.sleep(1.0)
+            await spawn_filer(0)
+            bad_ren += await ren_batch(n_ren // 3, n_ren)
+            if bad_ren:
+                print(f"  FAIL: {bad_ren} renames never completed")
+                failures += bad_ren
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                try:
+                    if not _http_json(port,
+                                      "/cluster/shards").get("moves"):
+                        break
+                except OSError:
+                    pass
+                await asyncio.sleep(1)
+            else:
+                print("  FAIL: rename moves never drained")
+                failures += 1
+            for i in range(n_ren):
+                expect[f"/soak/hot/m{i:03d}"] = 1_800_000_000.0 + i
+
+            # -- byte-verify: exactly-once, in-order enumeration -------
+            for fp in fports:
+                _filer_failpoints(fp, "DELETE")
+            _fp.reset()
+
+            async def list_all(d: str, limit: int = 7) -> dict:
+                out: dict[str, dict] = {}
+                start, inc = "", False
+                while True:
+                    page = await cli.list_dir(d, start_file=start,
+                                              limit=limit,
+                                              inclusive=inc)
+                    names = [e["FullPath"].rsplit("/", 1)[1]
+                             for e in page]
+                    if names != sorted(names):
+                        print(f"  FAIL: {d} page out of order")
+                        nonlocal_fail[0] += 1
+                    for e, nm in zip(page, names):
+                        if e["FullPath"] in out:
+                            print(f"  FAIL: duplicate "
+                                  f"{e['FullPath']} across pages")
+                            nonlocal_fail[0] += 1
+                        out[e["FullPath"]] = e
+                    if len(page) < limit:
+                        return out
+                    start = names[-1]
+
+            nonlocal_fail = [0]
+            got: dict[str, dict] = {}
+            dirs = ([f"/soak/hot/d{i}" for i in range(10)]
+                    + ["/soak/hot/live", "/soak/hot", "/soak/ren"])
+            for d in dirs:
+                for p, e in (await list_all(d)).items():
+                    if not e.get("IsDirectory"):
+                        got[p] = e
+            failures += nonlocal_fail[0]
+            missing = sorted(set(expect) - set(got))
+            extra = sorted(set(got) - set(expect))
+            stale = [p for p in expect
+                     if p in got and got[p]["Mtime"] != expect[p]]
+            if missing or extra or stale:
+                print(f"  FAIL: lost={len(missing)} dup/extra="
+                      f"{len(extra)} stale-mtime={len(stale)}")
+                for p in (missing[:3] + extra[:3] + stale[:3]):
+                    print(f"    {p}")
+                failures += len(missing) + len(extra) + len(stale)
+            else:
+                print(f"  byte-verify: {len(got)} entries exactly "
+                      f"once, every mtime intact")
+
+            # tombstone completeness: the SOURCE shard must hold no
+            # local copy of the moved prefix (peer-internal local=1
+            # listing bypasses routing)
+            left = _http_json(
+                fports[0],
+                "/__api__/list?path=/soak/hot&local=1").get(
+                "entries", [])
+            if left:
+                print(f"  FAIL: {len(left)} entries still on the "
+                      f"source shard after tombstone")
+                failures += 1
+            st = [_http_json(p, "/__debug__/shards") for p in fports]
+            print(f"  shard entries: {[s['entries'] for s in st]}, "
+                  f"replayed={st[0]['counters']['replayed']}, "
+                  f"ingested={st[1]['counters']['ingest']}")
+        return failures
+    finally:
+        _fp.reset()
+        procs.kill_all()
+
+
 SCENARIOS = {
     "ec": scenario_ec,
     "vacuum-race": scenario_vacuum_race,
@@ -1719,6 +2113,7 @@ SCENARIOS = {
     "heal": scenario_heal,
     "slo": scenario_slo,
     "qos": scenario_qos,
+    "meta": scenario_meta,
 }
 
 

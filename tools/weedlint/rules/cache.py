@@ -34,6 +34,11 @@ _SESSIONISH = re.compile(r"(?i)(sess|session|http|client|chan|channel)$")
 FAILPOINT_SCOPE = ("seaweedfs_tpu/server/", "seaweedfs_tpu/replication/",
                    "seaweedfs_tpu/util/client.py",
                    "seaweedfs_tpu/util/masterclient.py",
+                   # the sharded filer metadata plane: every routed
+                   # hop (redirect chase, merged-listing fan-out,
+                   # split/move migration batch) must be chaos-
+                   # reachable (filer.shard.route/split/move)
+                   "seaweedfs_tpu/filer/shard.py",
                    "seaweedfs_tpu/storage/store.py",
                    # the EC recovery data plane: degraded-read shard
                    # preads + the scrubber's window reads must sit
