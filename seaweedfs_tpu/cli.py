@@ -54,6 +54,14 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                    default=2048,
                    help="finished spans kept in the per-process "
                         "/debug/traces ring buffer")
+    p.add_argument("-profile.hz", dest="profile_hz", type=float,
+                   default=0.0,
+                   help="continuous sampling profiler rate in Hz "
+                        "(stats/profiler.py): folds every thread's "
+                        "stack into /debug/profile, attributed to the "
+                        "active trace tier; 0 (default) disables the "
+                        "always-on sampler — /debug/profile?seconds=N "
+                        "still records on-demand windows")
     p.add_argument("-timeline.interval", dest="timeline_interval",
                    type=float, default=10.0,
                    help="metrics-timeline snapshot cadence in seconds "
@@ -196,6 +204,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "tier_seal actions: sealed still-local "
                         "volumes are shipped to it; empty disables "
                         "cold-tiering actions")
+    m.add_argument("-introspect.deadline", dest="introspect_deadline",
+                   type=float, default=3.0,
+                   help="per-node deadline seconds for the "
+                        "/debug/cluster/* fan-out: a member that "
+                        "doesn't answer within it degrades to a "
+                        "missing_node row instead of stalling the "
+                        "assembled view")
 
     v = sub.add_parser("volume", help="start a volume server")
     _add_common(v)
@@ -753,6 +768,8 @@ async def _run_master(args) -> None:
     if args.workerIndex == 0:
         _watch_parent()
         worker_ctx = _make_worker_ctx(args, "master")
+    from .stats import introspect
+    introspect.init(args.introspect_deadline)
     toml_cfg = await tracing.run_in_executor(_load_master_toml)
     try:
         lo, _, hi = args.raft_timeout.partition(",")
@@ -2049,6 +2066,12 @@ def main(argv: list[str] | None = None) -> None:
             # index, or all N processes would clobber one file
             setup_profiling(args.cpuprofile, args.memprofile,
                             worker_index=getattr(args, "workerIndex", -1))
+        if getattr(args, "profile_hz", 0):
+            # continuous sampler: per process, so every -workers
+            # sibling samples itself and /debug/profile merges them
+            from .stats import profiler
+            profiler.init(args.profile_hz)
+            profiler.start()
         if os.environ.get("WEED_FAILPOINTS"):
             # armed at import by util/failpoints; an injected-fault run
             # must never be mistakable for a healthy one in the logs

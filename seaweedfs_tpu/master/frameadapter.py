@@ -39,7 +39,10 @@ from ..util.frame import (FLAG_FALLBACK, FrameDecoder, FrameError,
 
 # (method, path) -> MasterServer handler attribute. Deliberately a
 # closed whitelist: streaming responses (/cluster/watch), multipart
-# (/submit) and the debug surfaces stay aiohttp-only.
+# (/submit) and the bulk of the debug surfaces stay aiohttp-only.
+# /debug/traces is the one debug route admitted: cluster trace
+# assembly (stats/introspect.py) pulls peer masters' span rings over
+# the fabric, and its bounded-JSON body fits the frame contract.
 _FRAME_ROUTES = {
     ("POST", "/raft/vote"): "h_raft_vote",
     ("POST", "/raft/heartbeat"): "h_raft_heartbeat",
@@ -48,6 +51,7 @@ _FRAME_ROUTES = {
     ("GET", "/dir/lookup"): "h_lookup",
     ("POST", "/dir/lookup"): "h_lookup",
     ("GET", "/dir/assign"): "h_assign",
+    ("GET", "/debug/traces"): "h_traces",
 }
 
 

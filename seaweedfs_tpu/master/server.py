@@ -109,6 +109,9 @@ class MasterServer:
         self._site: web.TCPSite | None = None
         self._tasks: list[asyncio.Task] = []
         self._http: aiohttp.ClientSession | None = None
+        # lazily-built frame hub for cluster-scope introspection
+        # fan-out (stats/introspect.py) — frame-first, HTTP fallback
+        self._introspect_hub = None
         self._grow_lock = asyncio.Lock()
         # applied filer shard map mirror (filer/shard.py): fed by the
         # election's adopt hook at APPLY time; served on /cluster/shards
@@ -211,6 +214,28 @@ class MasterServer:
         app.router.add_post("/debug/timeline", h_tl)
         app.router.add_get("/debug/events", h_ev)
         app.router.add_get("/debug/health", h_hl)
+        # span ring + in-flight table: instance ATTRIBUTES (not
+        # closures in the router) because the frame adapter whitelist
+        # resolves handlers by getattr — peer masters pull trace spans
+        # over the fabric
+        self.h_traces, self.h_trace_requests = tracing.debug_handlers()
+        app.router.add_get("/debug/traces", self.h_traces)
+        app.router.add_get("/debug/requests", self.h_trace_requests)
+        from ..stats import profiler
+        from ..util import pprof
+        app.router.add_get("/debug/profile", profiler.debug_handler())
+        app.router.add_get("/debug/pprof", pprof.debug_handler())
+        # cluster scope: leader-side fan-out over every known member
+        # (multi-segment paths can't collide with the /{fid} catch-all)
+        app.router.add_get("/debug/cluster", self.h_cluster_index)
+        app.router.add_get("/debug/cluster/trace/{tid}",
+                           self.h_cluster_trace)
+        app.router.add_get("/debug/cluster/timeline",
+                           self.h_cluster_timeline)
+        app.router.add_get("/debug/cluster/events",
+                           self.h_cluster_events)
+        app.router.add_get("/debug/cluster/health",
+                           self.h_cluster_health)
         app.router.add_route("*", "/debug/autopilot", self.h_autopilot)
         app.router.add_get("/debug/qos", qos.debug_handler)
         app.router.add_route("*", "/vol/grow", self.h_grow)
@@ -302,6 +327,8 @@ class MasterServer:
             task.cancel()
         if self._http:
             await self._http.close()
+        if self._introspect_hub is not None:
+            await self._introspect_hub.close()
         if getattr(self, "_server", None) is not None:
             self._server.close()
             # NOT wait_closed() (3.12 waits on live keep-alives)
@@ -524,6 +551,125 @@ class MasterServer:
         return web.json_response(
             {"epoch": self.shard_epoch, "leader": self.leader_url or "",
              "map": m, "shards": shards})
+
+    # ---- cluster-scope introspection (stats/introspect.py) ----
+
+    def _frame_hub(self):
+        if self._introspect_hub is None:
+            from ..util.frame import FrameHub
+            from ..stats import introspect
+            self._introspect_hub = FrameHub(
+                ssl=tls.client_ctx(), jwt_key=self.jwt_key,
+                request_timeout=introspect.deadline_s())
+        return self._introspect_hub
+
+    async def _cluster_fanout(self, req: web.Request, path: str,
+                              params: "dict | None", local):
+        """One bounded debug pull per known member; any node serves it
+        (no leader gate — introspection must work mid-election)."""
+        from ..stats import introspect
+        nodes = introspect.cluster_nodes(
+            self, extra=req.query.get("extra", ""))
+        return await introspect.fanout(
+            nodes, path, self._http, frame_hub=self._frame_hub(),
+            params=params, local=local)
+
+    async def h_cluster_index(self, req: web.Request) -> web.Response:
+        """/debug/cluster: the views this master can assemble and the
+        member enumeration each one fans out over — the operator's
+        entry point (no network pulls: answering must never block)."""
+        from ..stats import introspect
+        nodes = introspect.cluster_nodes(
+            self, extra=req.query.get("extra", ""))
+        return web.json_response({
+            "views": ["/debug/cluster/trace/<id>",
+                      "/debug/cluster/timeline",
+                      "/debug/cluster/events",
+                      "/debug/cluster/health"],
+            "deadline_s": introspect.deadline_s(),
+            "nodes": [{"node": nd["node"], "kind": nd["kind"]}
+                      for nd in nodes],
+        })
+
+    async def h_cluster_trace(self, req: web.Request) -> web.Response:
+        """/debug/cluster/trace/<id>: every node's spans for ONE trace
+        assembled into a single tree with host/tier attribution and
+        explicit missing_nodes rows for members that didn't answer
+        inside -introspect.deadline."""
+        from ..stats import introspect
+        tid = req.match_info["tid"].strip()[:64]
+        if not tid:
+            return web.json_response({"error": "empty trace id"},
+                                     status=400)
+        results, missing = await self._cluster_fanout(
+            req, "/traces", {"trace": tid},
+            local=lambda: tracing.trace_spans_dict(tid))
+        return web.json_response(introspect.assemble_trace(
+            tid, [(nd["node"], p) for nd, p in results], missing))
+
+    async def h_cluster_timeline(self, req: web.Request) -> web.Response:
+        """/debug/cluster/timeline: every member's windows merged with
+        the whole-host discipline lifted to cluster scope (sum rates
+        and buckets, MAX the non-additive gauges, recompute quantiles
+        from merged buckets — never average)."""
+        from ..stats import timeline
+        try:
+            n = tracing.clamp_count(req.query.get("n", 60), cap=10_000)
+        except ValueError:
+            return web.json_response({"error": "bad n"}, status=400)
+        results, missing = await self._cluster_fanout(
+            req, "/timeline", {"n": str(n)},
+            local=lambda: timeline.timeline_dict(n=n, render=False))
+        merged = timeline.merge_payloads([p for _, p in results], n=n)
+        merged["nodes"] = len(results)
+        merged["missing_nodes"] = missing
+        return web.json_response(merged)
+
+    async def h_cluster_events(self, req: web.Request) -> web.Response:
+        """/debug/cluster/events: the structured journals of every
+        member zipped newest-first, rows tagged with their node."""
+        from ..util import events
+        try:
+            n = tracing.clamp_count(req.query.get("n", 100), cap=10_000)
+        except ValueError:
+            return web.json_response({"error": "bad n"}, status=400)
+        results, missing = await self._cluster_fanout(
+            req, "/events", {"n": str(n)},
+            local=lambda: events.events_dict(n=n))
+        payloads = []
+        for nd, p in results:
+            p["events"] = [{**r, "node": nd["node"]}
+                           for r in p.get("events", ())]
+            payloads.append(p)
+        merged = events.merge_payloads(payloads, n=n)
+        merged["nodes"] = len(results)
+        merged["missing_nodes"] = missing
+        return web.json_response(merged)
+
+    async def h_cluster_health(self, req: web.Request) -> web.Response:
+        """/debug/cluster/health: the SLO verdict evaluated over the
+        CLUSTER-merged timeline + journal — burn rates burn on
+        cluster-wide buckets, not one host's."""
+        from ..stats import slo, timeline
+        from ..util import events
+        wins = slo.windows_needed()
+        (tl_results, tl_missing), (ev_results, _) = await asyncio.gather(
+            self._cluster_fanout(
+                req, "/timeline", {"n": str(wins)},
+                local=lambda: timeline.timeline_dict(n=wins,
+                                                     render=False)),
+            self._cluster_fanout(
+                req, "/events", {"n": "500"},
+                local=lambda: events.events_dict(n=500)))
+        merged = timeline.merge_payloads([p for _, p in tl_results],
+                                         n=wins, render=False)
+        evs: list = []
+        for _, p in ev_results:
+            evs.extend(p.get("events", ()))
+        out = slo.health_dict(merged["windows"], events=evs)
+        out["nodes"] = len(tl_results)
+        out["missing_nodes"] = tl_missing
+        return web.json_response(out)
 
     def _leader_or_503(self) -> tuple[str | None, web.Response | None]:
         """Resolve the current leader, or the 503 every non-leader

@@ -136,6 +136,10 @@ class S3Gateway:
         app.router.add_get("/__debug__/health", h_hl)
         from .. import qos
         app.router.add_get("/__debug__/qos", qos.debug_handler)
+        from ..stats import profiler
+        from ..util import pprof
+        app.router.add_get("/__debug__/profile", profiler.debug_handler())
+        app.router.add_get("/__debug__/pprof", pprof.debug_handler())
         # the qos soak arms/disarms `qos.admit` here at runtime, the
         # same shared admin surface the volume/master/filer expose
         from ..util import failpoints
@@ -536,18 +540,27 @@ class S3Gateway:
         if self.filer.find_entry(f"{BUCKETS_DIR}/{bucket}") is None:
             return _err("NoSuchBucket", bucket, 404)
         mime = req.headers.get("Content-Type", "")
-        chunks, md5, sha_hex = await self._store_stream(
-            self._body_reader(req), collection=bucket, mime=mime)
-        if (bad := self._payload_hash_mismatch(req, chunks, sha_hex)):
-            return bad
-        now = time.time()
-        entry = Entry(path, Attr(mtime=now, crtime=now, mime=mime,
-                                 collection=bucket), chunks)
-        try:
-            self.filer.create_entry(entry)
-        except FilerError as e:
-            self.filer.delete_chunks([c.file_id for c in chunks])
-            return _err("InternalError", str(e), 500)
+        # filer-tier write span: the chunk fan-out + entry commit of
+        # this object write, with the volume uploads as client children
+        from ..util import tracing
+        with tracing.start("filer", "write") as sp:
+            chunks, md5, sha_hex = await self._store_stream(
+                self._body_reader(req), collection=bucket, mime=mime)
+            if (bad := self._payload_hash_mismatch(req, chunks,
+                                                   sha_hex)):
+                sp.status = "error"
+                return bad
+            now = time.time()
+            entry = Entry(path, Attr(mtime=now, crtime=now, mime=mime,
+                                     collection=bucket), chunks)
+            try:
+                self.filer.create_entry(entry)
+            except FilerError as e:
+                self.filer.delete_chunks([c.file_id for c in chunks])
+                sp.status = "error"
+                return _err("InternalError", str(e), 500)
+            sp.set("chunks", len(chunks))
+            sp.nbytes = sum(c.size for c in chunks)
         return web.Response(status=200,
                             headers={"ETag": f'"{md5.hexdigest()}"'})
 

@@ -161,6 +161,10 @@ class FilerServer:
         from .. import qos
         app.router.add_get("/__debug__/qos", qos.debug_handler)
         app.router.add_get("/__debug__/shards", self.h_debug_shards)
+        from ..stats import profiler
+        from ..util import pprof
+        app.router.add_get("/__debug__/profile", profiler.debug_handler())
+        app.router.add_get("/__debug__/pprof", pprof.debug_handler())
         # reserved-prefix path (like /__api__, /__debug__) so a stored
         # file named /metrics is never shadowed; exposes the chunk-cache
         # hit/miss/byte counters among the rest of the registry
@@ -539,40 +543,49 @@ class FilerServer:
             return web.json_response({"error": str(e)}, status=400)
         chunks: list[FileChunk] = []
         offset = 0
-        try:
-            while True:
-                data = await _read_up_to(reader, self.chunk_size)
-                if not data:
-                    break
-                a = await self.client.assign(
-                    collection=collection, replication=replication,
-                    ttl=ttl, data_center=self.data_center)
-                up = await self.client.upload(a["fid"], a["url"], data,
-                                              mime=mime, ttl=ttl,
-                                              auth=a.get("auth", ""))
-                chunks.append(FileChunk(
-                    file_id=a["fid"], offset=offset, size=len(data),
-                    mtime=time.time_ns(), etag=up.get("eTag", "")))
-                offset += len(data)
-                if len(data) < self.chunk_size:
-                    break
-        except OperationError as e:
-            # roll back uploaded chunks
-            self.filer.delete_chunks([c.file_id for c in chunks])
-            return web.json_response({"error": str(e)}, status=500)
+        # filer-tier write span: the chunk fan-out + entry commit,
+        # with the per-chunk volume uploads as client children
+        from ..util import tracing
+        with tracing.start("filer", "write") as fsp:
+            try:
+                while True:
+                    data = await _read_up_to(reader, self.chunk_size)
+                    if not data:
+                        break
+                    a = await self.client.assign(
+                        collection=collection, replication=replication,
+                        ttl=ttl, data_center=self.data_center)
+                    up = await self.client.upload(
+                        a["fid"], a["url"], data, mime=mime, ttl=ttl,
+                        auth=a.get("auth", ""))
+                    chunks.append(FileChunk(
+                        file_id=a["fid"], offset=offset,
+                        size=len(data), mtime=time.time_ns(),
+                        etag=up.get("eTag", "")))
+                    offset += len(data)
+                    if len(data) < self.chunk_size:
+                        break
+            except OperationError as e:
+                # roll back uploaded chunks
+                self.filer.delete_chunks([c.file_id for c in chunks])
+                fsp.status = "error"
+                return web.json_response({"error": str(e)}, status=500)
 
-        now = time.time()
-        entry = Entry(
-            full_path=path,
-            attr=Attr(mtime=now, crtime=now, mode=0o660, mime=mime,
-                      replication=replication, collection=collection,
-                      ttl_sec=ttl_sec),
-            chunks=chunks)
-        try:
-            self.filer.create_entry(entry)
-        except FilerError as e:
-            self.filer.delete_chunks([c.file_id for c in chunks])
-            return web.json_response({"error": str(e)}, status=400)
+            now = time.time()
+            entry = Entry(
+                full_path=path,
+                attr=Attr(mtime=now, crtime=now, mode=0o660, mime=mime,
+                          replication=replication,
+                          collection=collection, ttl_sec=ttl_sec),
+                chunks=chunks)
+            try:
+                self.filer.create_entry(entry)
+            except FilerError as e:
+                self.filer.delete_chunks([c.file_id for c in chunks])
+                fsp.status = "error"
+                return web.json_response({"error": str(e)}, status=400)
+            fsp.set("chunks", len(chunks))
+            fsp.nbytes = offset
         return web.json_response(
             {"name": filename or entry.name, "size": offset}, status=201)
 

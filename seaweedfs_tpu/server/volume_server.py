@@ -357,6 +357,10 @@ class VolumeServer:
         app.router.add_get("/debug/events", self.h_events)
         app.router.add_get("/debug/health", self.h_health)
         app.router.add_get("/debug/qos", self.h_qos)
+        # continuous sampling profiler + on-demand pprof dumps, both
+        # -workers merged/fanned like every debug surface
+        app.router.add_get("/debug/profile", self.h_profile)
+        app.router.add_get("/debug/pprof", self.h_pprof)
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", self.h_metrics)
         app.router.add_get("/stats/workers", self.h_stats_workers)
@@ -1458,7 +1462,23 @@ class VolumeServer:
     async def h_traces(self, req: web.Request) -> web.Response:
         """/debug/traces: recent + slowest-N traces from the in-memory
         span ring; under -workers, any worker answers for the whole
-        host by merging its siblings' rings (like /metrics)."""
+        host by merging its siblings' rings (like /metrics).
+        ``?trace=<id>`` instead pulls every span of ONE trace (ring +
+        in-flight) — the per-node feed cluster assembly fans over."""
+        tid = str(req.query.get("trace", "") or "").strip()[:64]
+        if tid:
+            payload = tracing.trace_spans_dict(tid)
+            wc = self.worker_ctx
+            if wc is not None and not self._is_worker_hop(req):
+                payloads = [payload]
+                for _, body in await self._sibling_get(
+                        f"/debug/traces?trace={tid}"):
+                    try:
+                        payloads.append(json.loads(body))
+                    except ValueError:
+                        continue
+                payload = tracing.merge_trace_payloads(payloads)
+            return web.json_response(payload)
         try:
             recent = tracing.clamp_count(req.query.get("n", 20))
             slowest = tracing.clamp_count(req.query.get("slowest", 10))
@@ -1633,6 +1653,63 @@ class VolumeServer:
             except ValueError:
                 continue
         return web.json_response(qos.merge_payloads(payloads))
+
+    async def h_profile(self, req: web.Request) -> web.Response:
+        """/debug/profile: the continuous sampling profiler's folded
+        stacks (?seconds=N records a fresh window; ?format=folded for
+        flamegraph-ready text); -workers merged by summing folded
+        counts — each worker samples only itself."""
+        from ..stats import profiler
+        try:
+            payload = await profiler.profile_query(req.query)
+        except ValueError:
+            return web.json_response({"error": "bad seconds/hz"},
+                                     status=400)
+        wc = self.worker_ctx
+        if wc is not None and not self._is_worker_hop(req):
+            payloads = [payload]
+            qs = urllib.parse.urlencode(
+                {k: req.query[k] for k in ("seconds", "hz")
+                 if k in req.query})
+            # a ?seconds=N window makes the sibling block for N: pad
+            # the fan-out timeout past the window instead of 3s
+            secs = float(req.query.get("seconds", 0) or 0)
+            for _, body in await self._sibling_fetch(
+                    "/debug/profile" + (f"?{qs}" if qs else ""),
+                    "GET", max(3.0, secs + 5.0)):
+                try:
+                    payloads.append(json.loads(body))
+                except ValueError:
+                    continue
+            payload = profiler.merge_payloads(payloads)
+        if req.query.get("format") == "folded":
+            from ..stats.profiler import folded_text
+            return web.Response(text=folded_text(payload),
+                                content_type="text/plain")
+        return web.json_response(payload)
+
+    async def h_pprof(self, req: web.Request) -> web.Response:
+        """/debug/pprof: which -cpuprofile/-memprofile collectors are
+        armed; ?dump=1 snapshots them to disk NOW (fanned across
+        -workers so every sibling's profile lands, not just the worker
+        the balancer picked)."""
+        from ..util import pprof
+        dump = req.query.get("dump", "") in ("1", "true")
+        payload: dict = {"workers": {}} if self.worker_ctx else {}
+        # executor hop: the mem dump writes a file
+        body = await tracing.run_in_executor(
+            lambda: pprof.pprof_dict(dump=dump))
+        wc = self.worker_ctx
+        if wc is None or self._is_worker_hop(req):
+            return web.json_response(body)
+        payload["workers"][str(wc.index)] = body
+        qs = "?dump=1" if dump else ""
+        for i, raw in await self._sibling_get("/debug/pprof" + qs):
+            try:
+                payload["workers"][str(i)] = json.loads(raw)
+            except ValueError:
+                continue
+        return web.json_response(payload)
 
     async def h_scrub(self, req: web.Request) -> web.Response:
         """/debug/scrub: paced-scrubber status; POST ?run=1 forces one

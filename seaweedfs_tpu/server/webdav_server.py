@@ -140,6 +140,13 @@ class WebDavServer:
             h_traces, h_requests = tracing.debug_handlers()
             return await (h_traces if path.endswith("traces")
                           else h_requests)(req)
+        if req.method == "GET" and path in ("/__debug__/profile",
+                                            "/__debug__/pprof"):
+            from ..stats import profiler
+            from ..util import pprof
+            return await (profiler.debug_handler()
+                          if path.endswith("profile")
+                          else pprof.debug_handler())(req)
         if (req.method == "GET" and path in (
                 "/__debug__/timeline", "/__debug__/events",
                 "/__debug__/health", "/__debug__/qos")) or (

@@ -198,6 +198,22 @@ if HAVE_PROMETHEUS:
         "SeaweedFS_process_start_time_seconds",
         "unix time this process imported the metrics registry",
         registry=REGISTRY)
+    # cluster-scope introspection (stats/introspect.py): every
+    # fan-out hop the leader makes to assemble /debug/cluster/* views,
+    # by result — a rising error/timeout share means some member's
+    # debug plane is dark (result is a closed set: ok|error|timeout)
+    INTROSPECT_FANOUT = Counter(
+        "SeaweedFS_introspect_fanout_total",
+        "per-node debug pulls issued by cluster-scope assembly, "
+        "by result",
+        ["result"], registry=REGISTRY)
+    # continuous sampling profiler (stats/profiler.py): one count per
+    # sampler tick, so `samples ≈ -profile.hz × uptime` is checkable
+    # and the overhead accounting is deterministic
+    PROFILE_SAMPLES = Counter(
+        "SeaweedFS_profile_samples_total",
+        "stack-sampler ticks taken by the continuous profiler",
+        registry=REGISTRY)
     # structured event journal (util/events.py): one count per recorded
     # cluster state transition, so the ring and Prometheus agree
     EVENTS_TOTAL = Counter(

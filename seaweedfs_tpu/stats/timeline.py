@@ -172,6 +172,8 @@ def snap() -> "dict | None":
     wall = time.time()
     mono = time.perf_counter()
     samples, kinds = _collect_flat()
+    from ..util import tracing
+    exemplars = tracing.drain_exemplars()
     with _lock:
         prev, _last_snap = _last_snap, (wall, mono, samples, kinds)
         if prev is None:
@@ -179,6 +181,10 @@ def snap() -> "dict | None":
         pwall, pmono, psamples, _ = prev
         dt = max(1e-9, mono - pmono)
         win = _window(wall, dt, samples, psamples, kinds)
+        if exemplars:
+            # worst trace per (tier, op) observed during this window —
+            # the link from a timeline row into /debug/cluster/trace/<id>
+            win["exemplars"] = exemplars
         _ring.append(win)
         return win
 
@@ -318,6 +324,17 @@ def _merge_gauge(key: str, old: float, new: float) -> float:
     return old + new
 
 
+def _merge_exemplars(dst: dict, src: "dict | None") -> None:
+    """Fold exemplar maps ({"tier.op": {"trace", "dur_ms"}}) across
+    windows: the WORST (max dur_ms) trace per key wins — exemplars are
+    pointers, not statistics, so there is nothing to sum."""
+    for k, ex in (src or {}).items():
+        cur = dst.get(k)
+        if cur is None or float(ex.get("dur_ms", 0.0)) > \
+                float(cur.get("dur_ms", 0.0)):
+            dst[k] = ex
+
+
 def _fold_same_process(windows, interval: float) -> "list[dict]":
     """Combine ONE payload's windows that land in the same wall bucket
     (a forced ``?snap=1`` a few hundred ms after the periodic snap):
@@ -338,6 +355,8 @@ def _fold_same_process(windows, interval: float) -> "list[dict]":
                                         "sum": h.get("sum", 0.0),
                                         "count": h.get("count", 0.0)}
                                     for b, h in w.get("hist", {}).items()}}
+            if w.get("exemplars"):
+                out[bucket]["exemplars"] = dict(w["exemplars"])
             continue
         dt0, dt1 = m["dt_s"], w["dt_s"]
         span = max(1e-9, dt0 + dt1)
@@ -356,6 +375,9 @@ def _fold_same_process(windows, interval: float) -> "list[dict]":
                 mh["buckets"][le] = mh["buckets"].get(le, 0.0) + c
             mh["sum"] = round(mh["sum"] + h.get("sum", 0.0), 9)
             mh["count"] += h.get("count", 0.0)
+        if w.get("exemplars"):
+            _merge_exemplars(m.setdefault("exemplars", {}),
+                             w["exemplars"])
         m["wall_ms"] = max(m["wall_ms"], w["wall_ms"])
         m["dt_s"] = round(span, 3)
     return [out[b] for b in sorted(out)]
@@ -404,6 +426,9 @@ def merge_payloads(payloads: "list[dict]", n: int = 60,
                     mh["buckets"][le] = mh["buckets"].get(le, 0.0) + c
                 mh["sum"] = round(mh["sum"] + h.get("sum", 0.0), 9)
                 mh["count"] += h.get("count", 0.0)
+            if w.get("exemplars"):
+                _merge_exemplars(m.setdefault("exemplars", {}),
+                                 w["exemplars"])
     wins = [merged[b] for b in sorted(merged)][-n:]
     return {"interval_s": interval, "ring": ring,
             "windows": [_render(w) for w in wins] if render else wins}

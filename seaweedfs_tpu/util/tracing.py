@@ -61,6 +61,20 @@ _lock = threading.Lock()
 _ring: deque = deque(maxlen=2048)
 _inflight: dict[int, "Span"] = {}
 
+# worst (slowest) finished entry per (tier, op) since the last drain —
+# the timeline exemplar feed: each histogram window links its worst
+# trace id so /debug/timeline rows jump straight into
+# /debug/cluster/trace/<id> (bounded like _hist_children)
+_exemplars: dict[tuple[str, str], tuple[float, str]] = {}
+_MAX_EXEMPLARS = 512
+
+# per-thread tier stack for the sampling profiler (stats/profiler.py):
+# a sampler thread cannot read another thread's contextvar, so span
+# enter/exit maintains this map — only while tracking is on (the
+# profiler armed), so unprofiled processes pay a single bool check
+_track_tiers = False
+_thread_tiers: dict[int, list[str]] = {}
+
 _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "swtpu_trace_span", default=None)
 
@@ -86,6 +100,36 @@ def reset() -> None:
     with _lock:
         _ring.clear()
         _inflight.clear()
+        _exemplars.clear()
+
+
+def track_thread_tiers(on: bool) -> None:
+    """Arm/disarm the per-thread tier map (profiler only)."""
+    global _track_tiers
+    _track_tiers = on
+    if not on:
+        _thread_tiers.clear()
+
+
+def thread_tier(tid: int) -> str:
+    """The tier of the span most recently entered on thread `tid`
+    (empty when the thread is not inside a traced request)."""
+    st = _thread_tiers.get(tid)
+    return st[-1] if st else ""
+
+
+def drain_exemplars() -> "dict[str, dict]":
+    """Worst finished trace per ``tier.op`` since the last drain —
+    consumed by timeline.snap() so each window carries its own
+    exemplars ({\"tier.op\": {\"trace\": id, \"dur_ms\": ms}})."""
+    with _lock:
+        if not _exemplars:
+            return {}
+        out = {f"{tier}.{op}": {"trace": trace,
+                                "dur_ms": round(dur, 3)}
+               for (tier, op), (dur, trace) in _exemplars.items()}
+        _exemplars.clear()
+    return out
 
 
 def enabled() -> bool:
@@ -199,9 +243,16 @@ class Span:
 
     def __enter__(self) -> "Span":
         self._token = _current.set(self)
+        if _track_tiers:
+            _thread_tiers.setdefault(
+                threading.get_ident(), []).append(self.tier)
         return self
 
     def __exit__(self, et, ev, tb) -> bool:
+        if _track_tiers:
+            st = _thread_tiers.get(threading.get_ident())
+            if st:
+                st.pop()
         tok, self._token = self._token, None
         if tok is not None:
             try:
@@ -240,6 +291,14 @@ class Span:
             _inflight.pop(id(self), None)
             if not self._discard:
                 _ring.append(self)
+                # exemplar feed: every (tier, op) in the histogram
+                # gets a worst-trace pointer, inner hops included
+                key = (self.tier, self.op)
+                ex = _exemplars.get(key)
+                if ex is None or self.dur > ex[0]:
+                    if len(_exemplars) > _MAX_EXEMPLARS:
+                        _exemplars.clear()   # cardinality bound
+                    _exemplars[key] = (self.dur, self.trace)
         if self._discard:
             return
         _observe(self.tier, self.op, self.status, self.dur / 1000.0)
@@ -399,6 +458,53 @@ def merge_payloads(payloads: list[dict], recent: int = 20,
     return _payload(_trace_groups(spans), recent, slowest)
 
 
+def trace_spans_dict(trace_id: str) -> dict:
+    """Every span of ONE trace known to THIS process: finished spans
+    from the ring plus currently in-flight sightings (marked
+    ``inflight`` with their age as dur_ms) — the per-node pull the
+    cluster assembler (stats/introspect.py) fans out for."""
+    now = time.perf_counter()
+    with _lock:
+        done = [_span_dict(s) for s in _ring if s.trace == trace_id]
+        live = [s for s in _inflight.values() if s.trace == trace_id]
+    for s in live:
+        row = {"trace": s.trace, "span": s.span_id, "parent": s.parent,
+               "tier": s.tier, "op": s.op, "status": "inflight",
+               "start_ms": round(s.wall0 * 1000.0, 3),
+               "dur_ms": round((now - s.t0) * 1000.0, 3),
+               "bytes": s.nbytes, "inflight": True}
+        attrs = s.attrs
+        if attrs:
+            try:
+                row["attrs"] = dict(attrs)
+            except RuntimeError:
+                # live span: its owner may insert attrs mid-copy
+                pass
+        done.append(row)
+    done.sort(key=lambda d: (d["start_ms"], d["span"]))
+    return {"trace": trace_id, "spans": done}
+
+
+def merge_trace_payloads(payloads: "list[dict]") -> dict:
+    """Fold several processes' ``?trace=`` pull bodies into one: span
+    ids dedupe (a finished record beats an in-flight sighting of the
+    same span), ordering stays deterministic for byte-identical
+    re-assembly."""
+    by_id: dict[str, dict] = {}
+    tid = ""
+    for p in payloads:
+        tid = tid or p.get("trace", "")
+        for d in p.get("spans", ()):
+            sid = d.get("span", "")
+            cur = by_id.get(sid)
+            if cur is None or (cur.get("inflight")
+                               and not d.get("inflight")):
+                by_id[sid] = d
+    spans = sorted(by_id.values(),
+                   key=lambda d: (d.get("start_ms", 0), d.get("span", "")))
+    return {"trace": tid, "spans": spans}
+
+
 def requests_dict() -> dict:
     """The /debug/requests JSON body: currently in-flight spans with
     their age — the wedged-request detector."""
@@ -441,7 +547,12 @@ def clamp_count(n: int, cap: int = MAX_QUERY_COUNT) -> int:
 def traces_query(query) -> dict:
     """traces_dict driven by a ?n=&slowest= query mapping — the one
     parser shared by every server's /debug/traces handler (raises
-    ValueError on malformed counts; negative/huge counts clamped)."""
+    ValueError on malformed counts; negative/huge counts clamped).
+    ``?trace=<id>`` switches to the single-trace span pull instead —
+    the hook cluster assembly fans out over."""
+    tid = str(query.get("trace", "") or "").strip()
+    if tid:
+        return trace_spans_dict(tid[:64])
     return traces_dict(recent=clamp_count(query.get("n", 20)),
                        slowest=clamp_count(query.get("slowest", 10)))
 

@@ -1,0 +1,112 @@
+"""Dump-on-demand profiling (util/pprof.py): dump_now() snapshots the
+armed cProfile/tracemalloc profiles mid-flight and keeps sampling,
+SIGUSR2 triggers the same dump, and /debug/pprof serves the armed
+state (?dump=1 writes the files) on the live servers.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+import pytest
+
+from seaweedfs_tpu.util import pprof
+
+from cluster_util import Cluster, run
+
+
+@pytest.fixture(autouse=True)
+def _clean_module_state():
+    """setup_profiling mutates module globals and starts tracemalloc;
+    restore so other tests see an unarmed process."""
+    yield
+    import tracemalloc
+    with pprof._lock:
+        prof = pprof._cpu[0] if pprof._cpu else None
+    if prof is not None:
+        prof.disable()
+    pprof._cpu = None
+    pprof._mem_path = ""
+    if tracemalloc.is_tracing():
+        tracemalloc.stop()
+
+
+def _burn():
+    return sum(i * i for i in range(20_000))
+
+
+def test_dump_now_snapshots_mid_flight_and_keeps_profiling(tmp_path):
+    cpu = str(tmp_path / "cpu.prof")
+    mem = str(tmp_path / "mem.txt")
+    pprof.setup_profiling(cpu_profile=cpu, mem_profile=mem)
+    assert pprof.pprof_dict() == {"cpu": True, "mem": True}
+    _burn()
+    out = pprof.dump_now()
+    assert out == {"cpu": cpu, "mem": mem}
+    assert os.path.getsize(cpu) > 0
+    assert os.path.getsize(mem) > 0
+    # profiling continued after the dump: a later snapshot has MORE
+    # accumulated call data than the first
+    first = os.path.getsize(cpu)
+    for _ in range(5):
+        _burn()
+    pprof.dump_now()
+    assert os.path.getsize(cpu) >= first
+
+
+def test_worker_index_suffixes_dump_paths(tmp_path):
+    assert pprof.profile_path("/x/p.out", 3) == "/x/p.out.w3"
+    assert pprof.profile_path("/x/p.out", -1) == "/x/p.out"
+    cpu = str(tmp_path / "w.prof")
+    pprof.setup_profiling(cpu_profile=cpu, worker_index=1)
+    assert pprof.dump_now() == {"cpu": cpu + ".w1"}
+    assert os.path.exists(cpu + ".w1")
+
+
+def test_sigusr2_dumps_on_demand(tmp_path):
+    cpu = str(tmp_path / "sig.prof")
+    pprof.setup_profiling(cpu_profile=cpu)
+    assert not os.path.exists(cpu)
+    os.kill(os.getpid(), signal.SIGUSR2)
+    # the handler runs synchronously on the main thread's next bytecode
+    for _ in range(100):
+        if os.path.exists(cpu):
+            break
+        time.sleep(0.01)
+    assert os.path.exists(cpu) and os.path.getsize(cpu) > 0
+
+
+def test_dump_now_unarmed_is_empty():
+    assert pprof.dump_now() == {}
+    assert pprof.pprof_dict(dump=True) == {"cpu": False, "mem": False,
+                                           "dumped": {}}
+
+
+def test_debug_pprof_route_reports_and_dumps(tmp_path):
+    async def go():
+        async with Cluster(str(tmp_path), n_servers=1) as c:
+            vs = c.servers[0]
+            # nothing armed: the route reports so without writing
+            async with c.http.get(
+                    f"http://{vs.url}/debug/pprof") as r:
+                assert r.status == 200
+                body = await r.json()
+            assert body == {"cpu": False, "mem": False}
+            # arm mid-run, then dump through the route
+            cpu = str(tmp_path / "route.prof")
+            pprof.setup_profiling(cpu_profile=cpu)
+            async with c.http.get(
+                    f"http://{vs.url}/debug/pprof",
+                    params={"dump": "1"}) as r:
+                assert r.status == 200
+                body = await r.json()
+            assert body["cpu"] and body["dumped"]["cpu"] == cpu
+            assert os.path.exists(cpu)
+            # the master serves the same handler
+            async with c.http.get(
+                    f"http://{c.master.url}/debug/pprof") as r:
+                assert r.status == 200
+                assert (await r.json())["cpu"] is True
+    run(go())

@@ -1,0 +1,154 @@
+"""Continuous sampling profiler (stats/profiler.py):
+
+- deterministic accounting: the absolute-deadline tick loop delivers
+  samples = hz x window within jitter (the overhead gate's contract);
+- trace-tier attribution: a thread burning inside an s3-tier span
+  folds under "s3;..." stacks;
+- bounded memory: past MAX_FOLDED distinct stacks new ones fold into
+  "(other)";
+- whole-host merge sums folded counts; folded_text renders stable
+  flamegraph lines; the shared query parser rejects junk.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.stats import profiler
+from seaweedfs_tpu.util import tracing
+
+from cluster_util import run
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    profiler.stop()
+    profiler.init(0.0)
+    profiler.reset()
+    yield
+    profiler.stop()
+    profiler.init(0.0)
+    profiler.reset()
+
+
+class _Burner:
+    """A busy thread with a recognizable frame for the sampler."""
+
+    def __init__(self, tier: str = ""):
+        self.tier = tier
+        self._stop = threading.Event()
+        self.t = threading.Thread(target=self._spin, daemon=True)
+
+    def _spin(self):
+        if self.tier:
+            with tracing.start_root(self.tier, "burn"):
+                self._burn_loop()
+        else:
+            self._burn_loop()
+
+    def _burn_loop(self):
+        x = 0
+        while not self._stop.is_set():
+            x = (x + 1) % 1000003
+
+    def __enter__(self):
+        self.t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self.t.join(timeout=2.0)
+
+
+def test_window_sample_accounting_tracks_hz_times_elapsed():
+    async def go():
+        with _Burner():
+            p = await profiler.profile_window(0.8, hz=250)
+        expect = 250 * 0.8
+        assert abs(p["samples"] - expect) <= expect * 0.10 + 2, p["samples"]
+        assert p["window_s"] == 0.8 and p["hz"] == 250
+        assert p["folded"] and not p["running"]
+        assert sum(p["folded"].values()) > 0
+    run(go())
+
+
+def test_window_attributes_active_trace_tier():
+    tracing.init(sample=1.0)
+
+    async def go():
+        # arm before the span is entered (profiler.start() does this
+        # at boot in production; the thread records its tier on entry)
+        tracing.track_thread_tiers(True)
+        with _Burner(tier="s3"):
+            p = await profiler.profile_window(0.4, hz=200)
+        tracing.track_thread_tiers(False)
+        s3_keys = [k for k in p["folded"] if k.startswith("s3;")]
+        assert s3_keys, list(p["folded"])[:5]
+        # the burner's own frame shows up under the attributed tier
+        assert any("_burn_loop" in k for k in s3_keys), s3_keys
+    run(go())
+
+
+def test_always_on_sampler_and_window_piggyback():
+    async def go():
+        profiler.init(200.0)
+        assert profiler.enabled()
+        profiler.start()
+        assert profiler.running()
+        with _Burner():
+            p = await profiler.profile_window(0.3)
+        # the piggybacked sink rode the always-on cadence
+        assert p["hz"] == 200.0 and p["running"]
+        expect = 200 * 0.3
+        assert abs(p["samples"] - expect) <= expect * 0.25 + 2
+        agg = profiler.profile_dict()
+        assert agg["samples"] >= p["samples"]
+        assert agg["running"] and agg["hz"] == 200.0
+        profiler.stop()
+        assert not profiler.running()
+    run(go())
+
+
+def test_init_zero_disables_start():
+    profiler.init(0.0)
+    assert profiler.start() is None
+    assert not profiler.running()
+
+
+def test_overflow_folds_into_other_bucket():
+    sink = {"folded": {f"stub;{i}": 1 for i in range(profiler.MAX_FOLDED)},
+            "samples": 0}
+    with _Burner():
+        time.sleep(0.02)
+        profiler._sample_once([sink])
+    assert sink["samples"] == 1
+    assert len(sink["folded"]) == profiler.MAX_FOLDED + 1
+    assert sink["folded"]["(other)"] >= 1
+
+
+def test_merge_sums_and_folded_text_is_stable():
+    p1 = {"hz": 99.0, "running": True, "window_s": 2.0, "samples": 10,
+          "folded": {"-;a.py:f": 6, "-;b.py:g": 1}}
+    p2 = {"hz": 50.0, "running": False, "window_s": 1.0, "samples": 4,
+          "folded": {"-;a.py:f": 2, "-;c.py:h": 2}}
+    m = profiler.merge_payloads([p1, p2])
+    assert m["samples"] == 14 and m["hz"] == 99.0 and m["running"]
+    assert m["folded"] == {"-;a.py:f": 8, "-;b.py:g": 1, "-;c.py:h": 2}
+    txt = profiler.folded_text(m)
+    assert txt == "-;a.py:f 8\n-;c.py:h 2\n-;b.py:g 1\n"
+    assert profiler.folded_text({"folded": {}}) == ""
+
+
+def test_profile_query_parses_and_rejects():
+    async def go():
+        out = await profiler.profile_query({})
+        assert out["window_s"] == 0.0       # the always-on aggregate
+        with pytest.raises(ValueError):
+            await profiler.profile_query({"seconds": "junk"})
+        # seconds clamp: a huge window is cut to MAX_WINDOW_S
+        p = await profiler.profile_query({"seconds": "0.1", "hz": "50"})
+        assert p["window_s"] == 0.1 and p["hz"] == 50.0
+    run(go())

@@ -365,3 +365,51 @@ def test_merge_metrics_texts_non_additive_gauges():
     assert lines["SeaweedFS_eventloop_lag_seconds"] == "0.05"
     # per-process resources still sum
     assert lines["SeaweedFS_open_fds"] == "100"
+
+
+# ---------------------------------------------------------------------------
+# exemplars: each window links its worst trace per (tier, op)
+
+
+def test_snap_attaches_worst_trace_exemplars():
+    tracing.init(sample=1.0, slow_ms=0.0)
+    tracing.reset()
+    timeline.snap()                         # baseline drains + drops
+    with tracing.start_root("volume", "read") as fast:
+        time.sleep(0.002)
+    with tracing.start_root("volume", "read") as slow:
+        time.sleep(0.03)
+    win = timeline.snap()
+    ex = win.get("exemplars", {})
+    assert "volume.read" in ex, win.keys()
+    # the WORST trace of the window wins the exemplar slot
+    assert ex["volume.read"]["trace"] == slow.trace != fast.trace
+    assert ex["volume.read"]["dur_ms"] >= 25.0
+    # drained: the next window starts fresh
+    win2 = timeline.snap()
+    assert "exemplars" not in win2
+    tracing.reset()
+
+
+def _exwin(wall_s: float, trace: str, dur: float) -> dict:
+    return {"wall_ms": wall_s * 1000.0, "dt_s": 1.0, "rates": {},
+            "gauges": {}, "hist": {},
+            "exemplars": {"s3.get": {"trace": trace, "dur_ms": dur}}}
+
+
+def test_merge_keeps_max_duration_exemplar_per_key():
+    # cross-process merge: the slower host's trace wins the key
+    p1 = {"interval_s": 1.0, "ring": 64,
+          "windows": [_exwin(100.0, "aa" * 16, 10.0)]}
+    p2 = {"interval_s": 1.0, "ring": 64,
+          "windows": [_exwin(100.2, "bb" * 16, 90.0)]}
+    m = timeline.merge_payloads([p1, p2], n=10)
+    assert len(m["windows"]) == 1
+    assert m["windows"][0]["exemplars"]["s3.get"] == {
+        "trace": "bb" * 16, "dur_ms": 90.0}
+    # same-process fold (forced ?snap=1 sub-windows) keeps the max too
+    p3 = {"interval_s": 1.0, "ring": 64,
+          "windows": [_exwin(100.0, "cc" * 16, 50.0),
+                      _exwin(100.4, "dd" * 16, 20.0)]}
+    m2 = timeline.merge_payloads([p3], n=10)
+    assert m2["windows"][0]["exemplars"]["s3.get"]["trace"] == "cc" * 16

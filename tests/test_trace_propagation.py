@@ -352,3 +352,100 @@ def test_frame_hop_stays_one_trace_with_transport_attr(tmp_path):
                 for vs in workers:
                     await vs.stop()
     run(go())
+
+
+def test_replication_fanout_frame_hop_keeps_one_trace(tmp_path):
+    """PR 14 hop audit — the replica fan-out: a replicated write's
+    traceparent rides the inter-host frame channel, so the replica's
+    own (volume, store) spans chain under the primary's replicate
+    span. With replication.frame armed the SAME write downgrades to
+    the HTTP fallback and the chain still holds."""
+    async def go():
+        async with Cluster(str(tmp_path), n_servers=2) as c:
+            for armed in (False, True):
+                if armed:
+                    fp.arm("replication.frame", "error:100")
+                a = await c.assign(replication="001")
+                tracing.reset()
+                trace_id = ("9a" if armed else "8b") * 16
+                tp = f"00-{trace_id}-{'7c' * 8}-01"
+                async with c.http.post(
+                        f"http://{a['url']}/{a['fid']}",
+                        data=b"replicate-trace" * 64,
+                        headers={"traceparent": tp}) as r:
+                    assert r.status == 201, await r.text()
+                g = [t for t in
+                     tracing.traces_dict(recent=100)["traces"]
+                     if t["trace_id"] == trace_id]
+                assert g, "write minted no trace"
+                spans = g[0]["spans"]
+                rep = [s for s in spans if s["tier"] == "replicate"]
+                assert rep, {s["tier"] for s in spans}
+                # the replica-side volume write chains UNDER the
+                # fan-out span: the header crossed the wire
+                replica_writes = [
+                    s for s in spans
+                    if s["tier"] == "volume" and s["op"] == "write"
+                    and s["parent"] == rep[0]["span"]]
+                assert replica_writes, spans
+                transport = replica_writes[0].get("attrs", {}).get(
+                    "transport")
+                # the frame-served replica stamps its transport; the
+                # HTTP fallback is the plain listener path (no stamp)
+                assert transport == (None if armed else "frame"), \
+                    (armed, replica_writes[0])
+                fp.reset()
+    run(go())
+
+
+def test_ec_shard_gather_hop_keeps_one_trace(tmp_path):
+    """PR 14 hop audit — the EC shard gather: reconstructing a needle
+    pulls shard intervals from remote holders; every remote
+    ec.shard_read span must join the reading trace (the gather's
+    injected traceparent rode the fetch)."""
+    import random
+
+    from seaweedfs_tpu.shell.env import CommandEnv
+    from seaweedfs_tpu.shell import ec_commands as ec
+
+    async def go():
+        async with Cluster(str(tmp_path), n_servers=4) as c:
+            rng = random.Random(7)
+            files = []
+            for _ in range(8):
+                a = await c.assign(collection="ectrace")
+                data = bytes(rng.getrandbits(8)
+                             for _ in range(rng.randint(2000, 9000)))
+                st, _ = await c.put(a["fid"], a["url"], data)
+                assert st == 201
+                files.append((a["fid"], data))
+            await c.heartbeat_all()
+            async with CommandEnv(c.master.url, c.http) as env:
+                vids = sorted({int(f.split(",")[0]) for f, _ in files})
+                res = await ec.ec_encode(env, collection="ectrace",
+                                         vids=vids)
+                assert res
+
+            fid, data = files[0]
+            tracing.reset()
+            trace_id = "6e" * 16
+            tp = f"00-{trace_id}-{'5f' * 8}-01"
+            st, got = None, None
+            async with c.http.get(
+                    f"http://{c.servers[0].url}/{fid}",
+                    headers={"traceparent": tp},
+                    allow_redirects=True) as r:
+                st, got = r.status, await r.read()
+            assert st == 200 and got == data
+            g = [t for t in tracing.traces_dict(recent=100)["traces"]
+                 if t["trace_id"] == trace_id]
+            assert g, "EC read minted no trace"
+            spans = g[0]["spans"]
+            by_id = {s["span"]: s for s in spans}
+            gathers = [s for s in spans if s["op"] == "ec.shard_read"]
+            assert gathers, {(s["tier"], s["op"]) for s in spans}
+            # every remote shard read chains to a parent INSIDE the
+            # trace — no orphaned roots from a dropped header
+            for s in gathers:
+                assert s["parent"] in by_id, s
+    run(go())

@@ -889,3 +889,26 @@ def test_stats_flag_prints_resolution_metrics(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "call resolution:" in out and "unresolved" in out
+
+
+def test_failpoint_site_covers_introspect_fanout(tmp_path):
+    """stats/introspect.py is in failpoint scope: a per-node
+    /debug/cluster/* pull added without a chaos site in reach is a
+    cluster-view hop the soak can never sever — the degrade-to-
+    missing_node contract would go unproven."""
+    found = probs(tmp_path, """
+        async def pull(self, http, addr, path):
+            async with http.get(addr + path) as resp:
+                return await resp.json()
+    """, name="seaweedfs_tpu/stats/introspect.py",
+        select=["failpoint-site"])
+    assert rule_ids(found) == ["failpoint-site"]
+    found = probs(tmp_path, """
+        from seaweedfs_tpu.util import failpoints
+        async def pull(self, http, addr, path):
+            await failpoints.fail("introspect.fanout")
+            async with http.get(addr + path) as resp:
+                return await resp.json()
+    """, name="seaweedfs_tpu/stats/introspect.py",
+        select=["failpoint-site"])
+    assert found == []

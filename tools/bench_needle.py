@@ -218,6 +218,20 @@ def bench_one(workers: int, n: int, size: int, conc: int,
                 for base, q in win.get("quantiles", {}).items():
                     if "request_duration" in base and "read" in base:
                         row.setdefault("p99_s", {})[base] = q.get("p99")
+                # exemplar link: the window's worst read trace id,
+                # chased through the leader's cluster assembly for a
+                # per-host/per-tier self-time table of THAT request
+                worst = None
+                for key, ex in (win.get("exemplars") or {}).items():
+                    if "read" in key or "get" in key:
+                        if worst is None or ex.get("dur_ms", 0) > \
+                                worst.get("dur_ms", 0):
+                            worst = ex
+                if worst and worst.get("trace"):
+                    print(f"--- cluster trace of worst read "
+                          f"({worst['dur_ms']}ms) ---", file=sys.stderr)
+                    print(trace_table.cluster_breakdown(
+                        master, worst["trace"]), file=sys.stderr)
             except (OSError, ValueError) as e:
                 print(f"(flight recorder pull failed: {e})",
                       file=sys.stderr)

@@ -375,6 +375,66 @@ def main() -> int:
         print(f"  shards: 307 hints + split journal (flip/done) + "
               f"map epoch {ds['epoch']} + moved="
               f"{ds['counters']['moved']} OK")
+
+        # -- /debug/profile (continuous profiler, -workers merged) ------
+        pr = get_json(vol, "/debug/profile?seconds=0.5")
+        for key in ("hz", "running", "window_s", "samples", "folded"):
+            check(key in pr, f"/debug/profile missing {key!r}")
+        check(pr["samples"] >= 10,
+              f"0.5s on-demand window took {pr['samples']} samples "
+              f"(expected ~99Hz x 0.5s x 2 workers)")
+        check(pr["folded"], "profiler window folded no stacks")
+        check(all(";" in k or k == "(other)" for k in pr["folded"]),
+              "folded keys not tier-prefixed stack;frames")
+        print(f"  profile: {pr['samples']} samples, "
+              f"{len(pr['folded'])} folded stacks in 0.5s window")
+
+        # -- /debug/cluster/trace/<id> (cross-host assembly) ------------
+        tid = "c0ffee" + "0" * 26
+        req = urllib.request.Request(
+            f"http://{vol}/{fids[0][0]}",
+            headers={"traceparent": f"00-{tid}-00000000000000ab-01"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            check(r.status == 200, f"traced read {r.status}")
+        ct = get_json(master, f"/debug/cluster/trace/{tid}")
+        for key in ("trace_id", "spans", "start_ms", "dur_ms", "tiers",
+                    "hosts", "complete", "missing_nodes", "tree"):
+            check(key in ct, f"/debug/cluster/trace missing {key!r}")
+        check(ct["trace_id"] == tid, "assembled wrong trace id")
+        check(ct["spans"] >= 1 and ct["tree"],
+              f"traced volume read not assembled (spans={ct['spans']})")
+        check(ct["complete"] and not ct["missing_nodes"],
+              f"healthy fleet reported missing nodes: "
+              f"{ct['missing_nodes']}")
+        check("volume" in ct["tiers"],
+              f"no volume tier in assembled trace ({ct['tiers']})")
+        check(any(s.get("host") for s in ct["tree"]),
+              "assembled spans carry no host attribution")
+        print(f"  cluster trace: {ct['spans']} span(s) across "
+              f"{len(ct['hosts'])} host(s), tiers="
+              f"{','.join(ct['tiers'])}")
+
+        # -- /debug/cluster/health (cluster-merged SLO verdict) ---------
+        ch = get_json(master, "/debug/cluster/health")
+        for key in ("status", "objectives", "now_ms", "nodes",
+                    "missing_nodes"):
+            check(key in ch, f"/debug/cluster/health missing {key!r}")
+        check(ch["nodes"] >= 3,
+              f"cluster health merged only {ch['nodes']} nodes "
+              f"(want master + volume + 2 filers)")
+        check(not ch["missing_nodes"],
+              f"healthy fleet missing {ch['missing_nodes']}")
+        # exemplar link: the traced read must surface a worst-trace
+        # pointer in the volume's timeline window
+        tl2 = get_json(vol, "/debug/timeline?snap=1", method="POST")
+        exs = {}
+        for w in tl2.get("windows", ()):
+            exs.update(w.get("exemplars") or {})
+        check(exs, "no timeline exemplars after traced traffic")
+        check(all("trace" in e and "dur_ms" in e for e in exs.values()),
+              "exemplar rows missing trace/dur_ms")
+        print(f"  cluster health: {ch['status']} over {ch['nodes']} "
+              f"nodes; {len(exs)} exemplar key(s) in the timeline")
         print("recorder smoke: OK")
         return 0
     finally:

@@ -13,6 +13,11 @@ and renders an aligned text table:
 Usage as a script:
 
     python tools/trace_table.py host:port [host:port ...]
+    python tools/trace_table.py --cluster master:port <trace_id>
+
+`--cluster` renders ONE assembled cross-host trace from the leader's
+/debug/cluster/trace/<id> — per-host/per-tier self-time, one row per
+(host, tier, op), plus the missing_nodes rows when members were down.
 """
 
 from __future__ import annotations
@@ -95,7 +100,90 @@ def breakdown(addrs: list[str], paths: dict[str, str] | None = None
     return render(rows_from_payloads([p for p in payloads if p]))
 
 
+# ---------------------------------------------------------------------------
+# --cluster: one assembled cross-host trace
+
+
+def fetch_cluster(master_addr: str, trace_id: str,
+                  extra: str = "", timeout: float = 30.0) -> dict | None:
+    """One assembled trace from the leader's /debug/cluster/trace/<id>
+    (extra= forwards unregistered members, e.g. 's3:host:port')."""
+    url = f"http://{master_addr}/debug/cluster/trace/{trace_id}"
+    if extra:
+        url += f"?extra={extra}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.load(r)
+    except (OSError, ValueError):
+        return None
+
+
+def _flatten_tree(tree: list[dict]) -> list[dict]:
+    out: list[dict] = []
+    stack = list(tree)
+    while stack:
+        d = stack.pop()
+        out.append(d)
+        stack.extend(d.get("children", ()))
+    return out
+
+
+def cluster_rows(assembled: dict) -> list[dict]:
+    """Per-(host, tier, op) self-time rows from one assembled cluster
+    trace — "which host's which tier ate this request's time"."""
+    per: dict[tuple[str, str, str], list[float]] = {}
+    for s in _flatten_tree(assembled.get("tree", ())):
+        per.setdefault((s.get("host", "?"), s.get("tier", "?"),
+                        s.get("op", "?")),
+                       []).append(s.get("self_ms",
+                                        s.get("dur_ms", 0.0)))
+    rows = []
+    for (host, tier, op), selfs in per.items():
+        rows.append({
+            "host": host, "tier": tier, "op": op, "spans": len(selfs),
+            "avg_self_ms": round(sum(selfs) / len(selfs), 3),
+            "total_self_ms": round(sum(selfs), 1),
+        })
+    rows.sort(key=lambda r: -r["total_self_ms"])
+    return rows
+
+
+def render_cluster(assembled: dict | None) -> str:
+    if not assembled or not assembled.get("tree"):
+        return "(no assembled spans — bad trace id, or rings rotated?)"
+    rows = cluster_rows(assembled)
+    cols = ["host", "tier", "op", "spans", "avg_self_ms",
+            "total_self_ms"]
+    table = [cols] + [[str(r[c]) for c in cols] for r in rows]
+    widths = [max(len(line[i]) for line in table)
+              for i in range(len(cols))]
+    out = [f"trace {assembled.get('trace_id', '?')}: "
+           f"{assembled.get('spans', 0)} spans, "
+           f"{assembled.get('dur_ms', 0)}ms, hosts="
+           f"{','.join(assembled.get('hosts', {}) or ['?'])}"]
+    for line in table:
+        out.append("  ".join(v.ljust(w) for v, w in zip(line, widths)))
+    for m in assembled.get("missing_nodes", ()):
+        out.append(f"missing: {m.get('node')} ({m.get('kind')}): "
+                   f"{m.get('error')}")
+    return "\n".join(out)
+
+
+def cluster_breakdown(master_addr: str, trace_id: str,
+                      extra: str = "") -> str:
+    return render_cluster(fetch_cluster(master_addr, trace_id,
+                                        extra=extra))
+
+
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--cluster":
+        if len(sys.argv) < 4:
+            print(__doc__)
+            sys.exit(2)
+        print(cluster_breakdown(sys.argv[2], sys.argv[3],
+                                extra=(sys.argv[4]
+                                       if len(sys.argv) > 4 else "")))
+        sys.exit(0)
     if len(sys.argv) < 2:
         print(__doc__)
         sys.exit(2)

@@ -60,7 +60,12 @@ FAILPOINT_SCOPE = ("seaweedfs_tpu/server/", "seaweedfs_tpu/replication/",
                    # send (worker.frame) and the sync frame pool the EC
                    # gather rides must stay chaos-reachable
                    "seaweedfs_tpu/util/frame.py",
-                   "seaweedfs_tpu/util/connpool.py")
+                   "seaweedfs_tpu/util/connpool.py",
+                   # cluster-scope introspection: the per-node debug
+                   # pull behind /debug/cluster/* must degrade to a
+                   # missing_node row under chaos (introspect.fanout) —
+                   # a hang here wedges the operator's one cluster view
+                   "seaweedfs_tpu/stats/introspect.py")
 
 
 def _mentions_evidence(fn: ast.AST, spec: re.Pattern) -> bool:
