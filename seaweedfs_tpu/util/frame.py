@@ -309,20 +309,28 @@ class FrameChannel:
             return
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._win_waiters.append(fut)
+        acquired = False
         try:
             await asyncio.wait_for(fut, timeout)
+            acquired = True
         except asyncio.TimeoutError as e:
-            try:
-                self._win_waiters.remove(fut)
-            except ValueError:
-                # woken (slot reserved) in the same tick the timeout
-                # fired: give the slot back
-                if fut.done() and fut.exception() is None:
-                    self._release_slot()
             raise FrameChannelError(
                 f"frame channel {self._label()}: congestion window "
                 f"wait timed out (window={self.window}, "
                 f"in flight={self._inflight})") from e
+        finally:
+            if not acquired:
+                # timeout OR caller cancellation must leave no trace:
+                # drop the queue entry, and if _wake_waiters already
+                # reserved a slot for this fut in the same tick, give
+                # the slot back — a cancelled waiter used to leak its
+                # reservation and permanently shrink the window
+                try:
+                    self._win_waiters.remove(fut)
+                except ValueError:
+                    if fut.done() and not fut.cancelled() \
+                            and fut.exception() is None:
+                        self._release_slot()
 
     def _release_slot(self) -> None:
         self._inflight -= 1
@@ -586,16 +594,21 @@ class FrameChannel:
                 self._observe_rtt(loop.time() - t0)
                 return status, hdrs, payload
             except asyncio.TimeoutError as e:
-                self._pending.pop(req_id, None)
                 raise FrameChannelError(
                     f"frame channel {self._label()}: request timeout") \
                     from e
             except (OSError, ConnectionResetError) as e:
-                self._pending.pop(req_id, None)
                 if isinstance(e, FrameChannelError):
                     raise
                 raise FrameChannelError(
                     f"frame channel {self._label()}: {e}") from e
+            finally:
+                # drop the pending entry on EVERY exit — the success
+                # path's _dispatch already popped it (idempotent), but
+                # a caller cancelled inside drain()/wait_for() used to
+                # leak the entry until response arrival or teardown,
+                # pinning the reader loop's timeout accounting
+                self._pending.pop(req_id, None)
         finally:
             self._release_slot()
 

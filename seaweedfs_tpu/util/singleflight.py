@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import asyncio
 
+from .aio import detach
+
 
 class SingleFlight:
     """Collapse concurrent ``do(key, fn)`` calls into one ``fn()``.
@@ -44,13 +46,10 @@ class SingleFlight:
             # including the one that started the round — must not
             # cancel the shared work out from under the others (a
             # disconnecting client would otherwise abort every
-            # concurrent reader of the same chunk)
-            task = asyncio.get_running_loop().create_task(
-                self._run(key, fn))
-            # consume the exception even if every caller was cancelled
-            # before awaiting, so nothing logs "never retrieved"
-            task.add_done_callback(
-                lambda t: t.cancelled() or t.exception())
+            # concurrent reader of the same chunk); aio.detach also
+            # retains the handle and consumes the terminal exception
+            # so nothing logs "never retrieved"
+            task = detach(self._run(key, fn))
             self._inflight[key] = task
         else:
             self.collapsed += 1

@@ -702,3 +702,40 @@ def test_cache_mem_budget_is_total(tmp_path):
     assert (s.needle_cache._lru.budget
             + s.ec_recover_cache.budget) == 16 << 20
     s.close()
+
+
+def test_aio_detach_survives_caller_and_consumes_exception():
+    """util.aio.detach is the one sanctioned detachment spelling: the
+    handle is retained until the task settles, cancelling the caller
+    does not cancel the work, and a terminal exception is consumed
+    even when no awaiter ever looks at it."""
+    from seaweedfs_tpu.util import aio
+
+    async def main():
+        ran = []
+
+        async def work():
+            await asyncio.sleep(0.02)
+            ran.append(True)
+            return "done"
+
+        async def caller():
+            t = aio.detach(work())
+            assert aio.detached_count() >= 1
+            await asyncio.sleep(1)           # cancelled long before
+
+        c = asyncio.create_task(caller())
+        await asyncio.sleep(0.005)
+        c.cancel()                           # caller dies...
+        await asyncio.sleep(0.05)
+        assert ran == [True]                 # ...the work does not
+        assert aio.detached_count() == 0     # handle released on settle
+
+        async def boom():
+            raise ValueError("nobody awaits me")
+
+        aio.detach(boom())
+        await asyncio.sleep(0.01)            # settles; exception is
+        assert aio.detached_count() == 0     # consumed, not logged
+
+    asyncio.run(main())

@@ -869,3 +869,64 @@ def test_teardown_fails_pending_even_without_error():
             finally:
                 await ch.close()
     run(body())
+
+
+def test_cancelled_request_leaves_no_pending_entry():
+    """The PR-20 cancel-leak fix: a requester cancelled while awaiting
+    its response must pop its _pending registration on the way out
+    (finally), not leak it until response arrival or teardown — a
+    leaked entry pins the reader loop's timeout accounting."""
+    async def body():
+        async with _EchoFrameServer() as srv:
+            ch = FrameChannel(target=f"127.0.0.1:{srv.port}")
+            try:
+                st, _, _ = await ch.request("GET", "/warm")
+                assert st == 200
+                next_id = srv.seen_req_ids[-1] + 1
+                srv.drop_ids = {next_id}     # never answered
+                t = asyncio.create_task(ch.request("GET", "/hang"))
+                await asyncio.sleep(0.05)    # parked awaiting the resp
+                assert next_id in ch._pending
+                t.cancel()
+                with pytest.raises(asyncio.CancelledError):
+                    await t
+                assert not ch._pending       # registration dropped
+                assert ch._inflight == 0     # slot released too
+                st, _, _ = await ch.request("GET", "/still-fine")
+                assert st == 200
+            finally:
+                await ch.close()
+    run(body())
+
+
+def test_cancelled_window_waiter_does_not_shrink_window():
+    """The PR-20 _acquire_slot fix: a waiter cancelled while parked on
+    the congestion window must leave the queue AND give back any slot
+    reserved for it in the same tick — the old shape permanently
+    shrank the window by one per cancelled waiter."""
+    async def body():
+        async with _EchoFrameServer() as srv:
+            ch = FrameChannel(target=f"127.0.0.1:{srv.port}")
+            try:
+                st, _, _ = await ch.request("GET", "/warm")
+                assert st == 200
+                ch._cwnd = 1.0               # one slot total
+                slow_id = srv.seen_req_ids[-1] + 1
+                srv.delay_ids = {slow_id: 0.2}
+                t1 = asyncio.create_task(ch.request("GET", "/slow"))
+                await asyncio.sleep(0.05)    # t1 owns the only slot
+                t2 = asyncio.create_task(ch.request("GET", "/parked"))
+                await asyncio.sleep(0.05)    # t2 queued on the window
+                assert len(ch._win_waiters) == 1
+                t2.cancel()
+                with pytest.raises(asyncio.CancelledError):
+                    await t2
+                assert not ch._win_waiters   # queue entry dropped
+                st, _, _ = await t1
+                assert st == 200
+                assert ch._inflight == 0     # window fully restored
+                st, _, _ = await ch.request("GET", "/after")
+                assert st == 200
+            finally:
+                await ch.close()
+    run(body())

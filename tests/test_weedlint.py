@@ -775,8 +775,12 @@ def test_module_entrypoint_runs():
 
 
 def test_rule_catalog_is_documented():
-    """STATIC_ANALYSIS.md documents every registered rule id, and
-    every rule carries the metadata the catalog is built from."""
+    """STATIC_ANALYSIS.md documents every registered rule id, every
+    rule carries the metadata the catalog is built from — and the
+    other direction holds too: every id the catalog tables claim is a
+    registered rule (a dead doc row would advertise a check that no
+    longer runs)."""
+    import re
     with open(os.path.join(REPO, "STATIC_ANALYSIS.md"),
               encoding="utf-8") as fh:
         doc = fh.read()
@@ -784,6 +788,12 @@ def test_rule_catalog_is_documented():
         assert cls.id and cls.title and cls.rationale and cls.fix, cls
         assert f"`{cls.id}`" in doc, \
             f"rule {cls.id} missing from STATIC_ANALYSIS.md"
+    documented = set(re.findall(r"^\|\s*`([a-z][a-z0-9-]*)`", doc,
+                                flags=re.M))
+    assert documented, "catalog tables not found in STATIC_ANALYSIS.md"
+    stale = documented - set(ALL_RULE_IDS)
+    assert not stale, \
+        f"STATIC_ANALYSIS.md catalogs unregistered rule ids: {stale}"
 
 
 # ---------------------------------------------------------------------
@@ -865,6 +875,44 @@ def test_changed_mode_lints_only_touched_files(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 1
     assert "touched.py" in out and "clean.py" not in out
+
+
+def test_changed_mode_follows_renames(tmp_path, capsys):
+    """An R row lints under its NEW path even when the host config
+    disables rename detection (`diff.renames false`) — the old path
+    must never stand in for it, and deletes are skipped, not linted."""
+    import subprocess as sp
+    repo = str(tmp_path)
+
+    def git(*args):
+        sp.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                "-c", "diff.renames=false", *args],
+               cwd=repo, check=True, capture_output=True)
+
+    git("init", "-q")
+    old = tmp_path / "old_name.py"
+    old.write_text(BAD_SRC)
+    gone = tmp_path / "gone.py"
+    gone.write_text("x = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "init")
+    git("config", "diff.renames", "false")
+    git("mv", "old_name.py", "new_name.py")
+    git("rm", "-q", "gone.py")
+    from tools.weedlint import cli as wl_cli
+    saved = wl_cli.REPO
+    wl_cli.REPO = repo
+    try:
+        files = wl_cli.changed_files("HEAD", [repo], repo=repo)
+        rc = weedlint_main([str(tmp_path), "--changed", "HEAD",
+                            "--no-baseline"])
+    finally:
+        wl_cli.REPO = saved
+    assert [os.path.basename(f) for f in files] == ["new_name.py"]
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "new_name.py" in out
+    assert "old_name.py" not in out and "gone.py" not in out
 
 
 def test_jobs_parallel_output_matches_serial(tmp_path, capsys):

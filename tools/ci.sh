@@ -63,6 +63,22 @@ if ! python -m tools.weedlint tests \
 fi
 python -m tools.weedlint tests --report-only --no-baseline | tail -n 1
 
+echo "== weedsched --quick (interleaving explorer: cores green, seeded bugs caught) =="
+# the dynamic half of the phase-3 cancellation gate: real protocol
+# cores must hold their invariants under permuted schedules + injected
+# cancellation, and the two seeded known-bug fixtures MUST be detected
+# (a green fixture means the explorer lost its teeth). WS_BUDGET_S
+# bounds the quick corpus the same way WL_BUDGET_S bounds weedlint.
+WS_BUDGET_S=${WS_BUDGET_S:-60}
+if ! timeout -k 10 $((WS_BUDGET_S + 30)) env JAX_PLATFORMS=cpu \
+        WS_BUDGET_S="$WS_BUDGET_S" python -m tools.weedsched --quick; then
+    echo "weedsched: FAILED (a protocol core broke an invariant under"
+    echo "some schedule/cancellation — the minimized trace above is a"
+    echo "deterministic repro — or a seeded fixture went undetected,"
+    echo "or the quick corpus blew WS_BUDGET_S; see STATIC_ANALYSIS.md)"
+    exit 1
+fi
+
 echo "== wire smoke (batch + group commit + sendfile + frame hop) =="
 if ! timeout -k 10 120 env JAX_PLATFORMS=cpu python tools/wire_smoke.py; then
     echo "wire smoke: FAILED (data-plane regression — see output above)"

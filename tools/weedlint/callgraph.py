@@ -192,14 +192,45 @@ class Program:
                 if ci is not None:
                     fi.var_types[a.arg] = ci.qual
         for node in iter_own_nodes(fi.node):
-            if (isinstance(node, ast.Assign)
+            if not (isinstance(node, ast.Assign)
                     and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and isinstance(node.value, ast.Call)):
-                ci = self.table.resolve_class_chain(
-                    fi, chain_of(node.value.func))
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            if isinstance(node.value, ast.Call):
+                value_chain = chain_of(node.value.func)
+                if value_chain and value_chain[-1] == "partial" \
+                        and node.value.args:
+                    # f = functools.partial(self.method, x): calling
+                    # f() lands on the wrapped callable
+                    target = self._resolve_callable_ref(
+                        fi, chain_of(node.value.args[0]))
+                    if target is not None:
+                        fi.var_funcs[name] = target
+                        continue
+                ci = self.table.resolve_class_chain(fi, value_chain)
                 if ci is not None:
-                    fi.var_types[node.targets[0].id] = ci.qual
+                    fi.var_types[name] = ci.qual
+            elif isinstance(node.value, (ast.Attribute, ast.Name)):
+                # f = self.method / f = helper: a bound-method or
+                # function alias — calling f() lands on the target
+                target = self._resolve_callable_ref(
+                    fi, chain_of(node.value))
+                if target is not None:
+                    fi.var_funcs[name] = target
+
+    def _resolve_callable_ref(self, fi: FunctionInfo,
+                              chain) -> FunctionInfo | None:
+        """A callable REFERENCE (no call parens): the FunctionInfo a
+        later `ref()` would land on, or None when unresolvable. Class
+        references are excluded — aliasing a class then calling it is
+        construction, which var_types already models."""
+        if not chain:
+            return None
+        if self.table.resolve_class_chain(fi, chain) is not None:
+            return None
+        kind, target = self._resolve(fi, chain)
+        return target if kind == "resolved" else None
 
     def _classify(self, fi: FunctionInfo, node: ast.Call) -> CallSite:
         chain = chain_of(node.func)
@@ -232,6 +263,8 @@ class Program:
             return "unresolved", None
         if chain[-1] in BUILTIN_METHODS and len(chain) > 1:
             return "external", None
+        if len(chain) == 1 and head in fi.var_funcs:
+            return "resolved", fi.var_funcs[head]
         if head in ("self", "cls") and fi.cls is not None:
             if len(chain) == 2:
                 m = table.lookup_method(fi.cls, chain[1])

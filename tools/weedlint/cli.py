@@ -16,8 +16,7 @@ from dataclasses import dataclass, field
 from . import baseline as baseline_mod
 from .baseline import Baseline
 from .core import REPO, Finding
-from .rules import (ALL_RULE_CLASSES, TESTS_ENFORCED_RULE_IDS,
-                    make_rules)
+from .rules import ALL_RULE_CLASSES, SELECT_PRESETS, make_rules
 
 DEFAULT_PATHS = ["seaweedfs_tpu", "tools"]
 
@@ -87,9 +86,10 @@ def build_parser() -> argparse.ArgumentParser:
                         f"{' '.join(DEFAULT_PATHS)})")
     p.add_argument("--select", default="",
                    help="comma-separated rule ids to run (default "
-                        "all); the preset 'tests-enforced' expands to "
-                        "rules.TESTS_ENFORCED_RULE_IDS so ci.sh and "
-                        "the tests share one source of truth")
+                        "all); presets 'tests-enforced' and 'cancel' "
+                        "expand to the id tuples in rules/__init__.py "
+                        "so ci.sh and the tests share one source of "
+                        "truth")
     p.add_argument("--ignore", default="",
                    help="comma-separated rule ids to skip")
     p.add_argument("--format", choices=("text", "json"),
@@ -132,12 +132,17 @@ def changed_files(ref: str, scope_paths: list[str],
                   repo: str = REPO) -> list[str]:
     """Files changed vs `ref` (plus untracked), filtered to .py under
     the scanned paths — plus changed .md anywhere, so docs-drift can
-    report into an edited catalog. Deleted files are skipped (nothing
-    to parse). Raises RuntimeError when git fails — a typo'd ref or a
+    report into an edited catalog. Renames are followed explicitly
+    (`--name-status --find-renames`, immune to the host's
+    diff.renames config): an R row lints under its NEW path — the
+    stale old path must never stand in for it, nor silently drop the
+    file from the changed set. Deleted files are skipped (nothing to
+    parse). Raises RuntimeError when git fails — a typo'd ref or a
     shallow checkout must NOT silently lint nothing and pass."""
     import subprocess
     out: list[str] = []
-    for cmd in (["git", "diff", "--name-only", ref, "--"],
+    for cmd in (["git", "diff", "--name-status", "--find-renames",
+                 ref, "--"],
                 ["git", "ls-files", "--others", "--exclude-standard"]):
         try:
             proc = subprocess.run(cmd, cwd=repo, capture_output=True,
@@ -149,7 +154,19 @@ def changed_files(ref: str, scope_paths: list[str],
             raise RuntimeError(
                 f"--changed: {' '.join(cmd)!r} exited "
                 f"{proc.returncode}: {proc.stderr.strip()}")
-        out += proc.stdout.splitlines()
+        if "--name-status" not in cmd:
+            out += proc.stdout.splitlines()
+            continue
+        for line in proc.stdout.splitlines():
+            fields = line.split("\t")
+            if len(fields) < 2:
+                continue
+            status = fields[0]
+            if status.startswith("D"):
+                continue                      # deleted: nothing to parse
+            # R100/C75 rows are "status<TAB>old<TAB>new": the NEW path
+            # is the file that exists and must be linted
+            out.append(fields[-1])
     scopes = [os.path.relpath(os.path.abspath(p), repo)
               .replace(os.sep, "/") for p in scope_paths]
     picked: list[str] = []
@@ -174,8 +191,7 @@ def main(argv: list[str] | None = None) -> int:
                            for p in DEFAULT_PATHS]
     select = [s for s in args.select.split(",") if s]
     select = [r for s in select
-              for r in (TESTS_ENFORCED_RULE_IDS
-                        if s == "tests-enforced" else (s,))]
+              for r in SELECT_PRESETS.get(s, (s,))]
     ignore = [s for s in args.ignore.split(",") if s]
     try:
         rules = make_rules(select or None, ignore or None)

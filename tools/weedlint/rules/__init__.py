@@ -6,6 +6,8 @@ from __future__ import annotations
 from .asynchrony import (AwaitInLockRule, BlockingIoRule,
                          LockAcquireRule, OrphanTaskRule)
 from .cache import CacheInvalidateRule, FailpointSiteRule
+from .cancel import (AwaitAtomicityRule, CancelLeakRule,
+                     DetachDisciplineRule)
 from .drift import DocsDriftRule
 from .exceptions import SilentExceptRule
 from .executor import ExecutorCtxRule
@@ -36,6 +38,11 @@ ALL_RULE_CLASSES = (
     TransitiveOrphanSpanRule,
     UnresolvedCallRule,
     DocsDriftRule,
+    # phase 3: cancellation/atomicity dataflow (same phase-2 driver,
+    # riding the call graph one resolved call deep)
+    CancelLeakRule,
+    AwaitAtomicityRule,
+    DetachDisciplineRule,
 )
 
 # findings the framework itself emits (no Rule class walks for these)
@@ -57,6 +64,19 @@ TESTS_ENFORCED_RULE_IDS = ("silent-except", "orphan-task",
 # its shim keeps exactly this behavior
 LEGACY_RULE_IDS = ("silent-except", "metric-name", "metric-help",
                    "span-finish")
+
+# the phase-3 cancellation/atomicity subset (the `--select cancel`
+# preset: the focused pre-commit loop after touching an await-heavy
+# protocol core)
+CANCEL_RULE_IDS = ("cancel-leak", "await-atomicity",
+                   "detach-discipline")
+
+# --select presets: one name expanding to a maintained id tuple so
+# ci.sh, tests and humans share a single source of truth
+SELECT_PRESETS = {
+    "tests-enforced": TESTS_ENFORCED_RULE_IDS,
+    "cancel": CANCEL_RULE_IDS,
+}
 
 
 def make_rules(select=None, ignore=None):

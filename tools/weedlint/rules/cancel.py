@@ -1,0 +1,519 @@
+"""Phase-3 rules: what happens BETWEEN two awaits.
+
+Every await is a point where the caller may be cancelled —
+``CancelledError`` materializes at the suspension point and unwinds
+the frame. State mutated before the await and repaired after it is
+exactly the bug class this repo's review history keeps re-finding by
+hand (the PR-10 FrameChannel pending-table leak, the PR-3
+generation-fence cache fill, the PR-3 singleflight leader abort).
+These passes ride the phase-2 symbol table + call graph so a
+registration, its undo, or a re-validation may hide one resolved call
+deep; the companion dynamic checker is tools/weedsched, which
+actually executes the protocol cores under adversarial schedules.
+
+* cancel-leak       — a mutation that registers state (dict/set
+  insert on a ``self.`` attr, lock acquire, counter increment)
+  followed by an await must pair its undo in a ``finally`` (or a
+  CancelledError-catching handler), unless the registered value is a
+  sanctioned detached task whose own body owns the cleanup.
+* await-atomicity   — read-check → await → write over the same
+  guarded ``self.`` attr with no re-read between the await and the
+  write: the check is stale by the time the write lands.
+* detach-discipline — a task documented to survive its caller's
+  cancellation must be created via util.aio.detach, not a bare
+  ``create_task`` (which drops handle retention + exception
+  consumption, and hides the detachment from reviewers).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..callgraph import Program, iter_own_nodes
+from ..core import ProgramRule
+from ..symbols import FunctionInfo, chain_of
+from .interproc import _short
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# mutating container calls that REGISTER an entry
+_INSERT_TAILS = frozenset({"add", "append", "appendleft",
+                           "setdefault"})
+# calls that UNDO a registration / finish a held resource
+_UNDO_TAILS = frozenset({"pop", "popleft", "discard", "remove",
+                         "clear", "release"})
+# container-mutating calls for the atomicity pass (supersets insert)
+_MUTATE_TAILS = _INSERT_TAILS | frozenset({"update", "insert",
+                                           "extend"})
+# ways to spawn work whose ownership leaves this frame
+_DETACH_TAILS = frozenset({"create_task", "ensure_future", "detach"})
+# the one sanctioned detach helper (fixture trees mirror the layout,
+# so the qual matches there too)
+_SANCTIONED_DETACH_QUALS = frozenset({"seaweedfs_tpu.util.aio.detach"})
+
+_CANCELLISH = frozenset({"BaseException", "CancelledError"})
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'X' when `node` is exactly the attribute `self.X`."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _self_chain(node: ast.AST) -> tuple[str, ...] | None:
+    chain = chain_of(node)
+    if chain and chain[0] == "self" and len(chain) >= 2:
+        return chain
+    return None
+
+
+def _walk_stmts(stmts):
+    """Every node under `stmts`, never entering nested defs/lambdas."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _FUNC_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _Events:
+    """Direct registration/undo events of one function body."""
+
+    __slots__ = ("regs", "undos")
+
+    def __init__(self):
+        # attr -> [(lineno, kind, value_expr|None)]
+        self.regs: dict[str, list] = {}
+        # attr -> [lineno]
+        self.undos: dict[str, list] = {}
+
+
+def _direct_events(fi: FunctionInfo) -> _Events:
+    ev = _Events()
+    for node in iter_own_nodes(fi.node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr:
+                        ev.regs.setdefault(attr, []).append(
+                            (node.lineno, "insert", node.value))
+        elif isinstance(node, ast.AugAssign):
+            attr = _self_attr(node.target)
+            if attr:
+                if isinstance(node.op, ast.Add):
+                    ev.regs.setdefault(attr, []).append(
+                        (node.lineno, "increment", None))
+                elif isinstance(node.op, ast.Sub):
+                    ev.undos.setdefault(attr, []).append(node.lineno)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr:
+                        ev.undos.setdefault(attr, []).append(
+                            node.lineno)
+        elif isinstance(node, ast.Call):
+            chain = _self_chain(node.func)
+            if not chain or len(chain) != 3:
+                continue
+            attr, tail = chain[1], chain[2]
+            if tail in _INSERT_TAILS:
+                ev.regs.setdefault(attr, []).append(
+                    (node.lineno, "insert", None))
+            elif tail == "acquire":
+                ev.regs.setdefault(attr, []).append(
+                    (node.lineno, "acquire", None))
+            elif tail in _UNDO_TAILS:
+                ev.undos.setdefault(attr, []).append(node.lineno)
+    return ev
+
+
+def _is_detach_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    chain = chain_of(node.func)
+    return bool(chain) and chain[-1] in _DETACH_TAILS
+
+
+class CancelLeakRule(ProgramRule):
+    id = "cancel-leak"
+    title = "state registered before an await, undo not finally'd"
+    rationale = ("every await is a cancellation point: "
+                 "CancelledError materializes there and unwinds the "
+                 "frame, skipping any sequential or except-handler "
+                 "cleanup. A pending-table insert, lock acquire or "
+                 "in-flight counter increment whose undo is not in a "
+                 "finally (or a CancelledError-catching handler) "
+                 "leaks the entry the first time a caller is "
+                 "cancelled mid-await — the PR-10 FrameChannel "
+                 "pending-registration leak. The registration or its "
+                 "undo may hide one resolved call deep; handing the "
+                 "registered value to a sanctioned detached task "
+                 "moves the cleanup obligation into that task.")
+    example = ("self._pending[req_id] = fut\n"
+               "await writer.drain()          # cancelled here ->\n"
+               "self._pending.pop(req_id)     # never runs: entry "
+               "leaks")
+    fix = ("wrap the awaits in try/finally with the undo in the "
+           "finally (pop/discard/release/decrement are idempotent "
+           "spellings), or detach the owning work via "
+           "util.aio.detach")
+
+    def run(self, program: Program, reporter) -> None:
+        self._summaries: dict[str, _Events] = {}
+        for fi in program.table.functions.values():
+            if fi.is_async:
+                self._check(program, fi, reporter)
+
+    def _summary(self, fi: FunctionInfo) -> _Events:
+        ev = self._summaries.get(fi.qual)
+        if ev is None:
+            ev = self._summaries[fi.qual] = _direct_events(fi)
+        return ev
+
+    def _check(self, program: Program, fi: FunctionInfo,
+               reporter) -> None:
+        awaits = [n for n in iter_own_nodes(fi.node)
+                  if isinstance(n, ast.Await)]
+        if not awaits:
+            return
+        ev = _direct_events(fi)
+        sites = {s.node: s for s in program.calls.get(fi.qual, ())}
+        # registration/undo one resolved self-call deep (sync callees
+        # only: an async callee has its own cancellation points and is
+        # analyzed as its own frame)
+        for site in sites.values():
+            if site.kind != "resolved" or site.target is None \
+                    or site.target.is_async \
+                    or not site.chain or site.chain[0] != "self":
+                continue
+            sub = self._summary(site.target)
+            for attr, regs in sub.regs.items():
+                kinds = {k for _, k, _ in regs}
+                for kind in sorted(kinds):
+                    ev.regs.setdefault(attr, []).append(
+                        (site.lineno, kind, None))
+            for attr in sub.undos:
+                ev.undos.setdefault(attr, []).append(site.lineno)
+
+        parent = _parent_map(fi.node)
+        detached_names = {
+            t.id for n in iter_own_nodes(fi.node)
+            if isinstance(n, ast.Assign) and _is_detach_call(n.value)
+            for t in n.targets if isinstance(t, ast.Name)}
+
+        for attr in sorted(set(ev.regs) & set(ev.undos)):
+            undo_max = max(ev.undos[attr])
+            for lineno, kind, value in sorted(ev.regs[attr]):
+                if kind == "insert" and value is not None and (
+                        _is_detach_call(value)
+                        or (isinstance(value, ast.Name)
+                            and value.id in detached_names)):
+                    continue        # ownership moved to a detached task
+                window = [a for a in awaits
+                          if lineno < a.lineno < undo_max]
+                bad = next(
+                    (a for a in window
+                     if not self._covered(program, fi, a, attr,
+                                          parent, sites)), None)
+                if bad is None:
+                    continue
+                what = {"insert": f"entry registered in self.{attr}",
+                        "acquire": f"self.{attr} acquired",
+                        "increment": f"self.{attr} incremented",
+                        }[kind]
+                reporter.report(
+                    self, fi.rel, lineno,
+                    f"{what} in {fi.name}() but the await at line "
+                    f"{bad.lineno} is not covered by a finally that "
+                    f"undoes it — a caller cancelled at that await "
+                    f"leaks the registration; move the undo into a "
+                    f"try/finally around the awaits")
+                break               # one finding per (function, attr)
+
+    def _covered(self, program: Program, fi: FunctionInfo,
+                 await_node: ast.AST, attr: str, parent: dict,
+                 sites: dict) -> bool:
+        """Is `await_node` inside a try whose finally (or a
+        CancelledError-catching handler) undoes `attr`, directly or
+        one resolved call deep?"""
+        cur = await_node
+        while True:
+            anc = parent.get(id(cur))
+            if anc is None or isinstance(anc, _FUNC_NODES):
+                return False
+            if isinstance(anc, ast.Try) and not self._in_cleanup(
+                    anc, cur):
+                if anc.finalbody and self._undoes(
+                        program, anc.finalbody, attr, sites):
+                    return True
+                for h in anc.handlers:
+                    if self._handler_cancellish(h) and self._undoes(
+                            program, h.body, attr, sites):
+                        return True
+            cur = anc
+
+    @staticmethod
+    def _in_cleanup(try_node: ast.Try, child: ast.AST) -> bool:
+        """Is `child` the try's handler/finally arm (rather than under
+        its body/orelse)? Cleanup code cancelled mid-cleanup is out of
+        scope for this pass."""
+        if isinstance(child, ast.ExceptHandler):
+            return True
+        return any(child is stmt for stmt in try_node.finalbody)
+
+    @staticmethod
+    def _handler_cancellish(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True                         # bare except
+        names = [handler.type] if not isinstance(
+            handler.type, ast.Tuple) else list(handler.type.elts)
+        for n in names:
+            chain = chain_of(n)
+            if chain and chain[-1] in _CANCELLISH:
+                return True
+        return False
+
+    def _undoes(self, program: Program, stmts, attr: str,
+                sites: dict) -> bool:
+        for node in _walk_stmts(stmts):
+            if isinstance(node, ast.Call):
+                chain = _self_chain(node.func)
+                if chain and len(chain) == 3 and chain[1] == attr \
+                        and chain[2] in _UNDO_TAILS:
+                    return True
+                site = sites.get(node)
+                if site is not None and site.kind == "resolved" \
+                        and site.target is not None \
+                        and site.chain and site.chain[0] == "self" \
+                        and attr in self._summary(site.target).undos:
+                    return True
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.op, ast.Sub) \
+                    and _self_attr(node.target) == attr:
+                return True
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and _self_attr(t.value) == attr:
+                        return True
+        return False
+
+
+def _parent_map(fn_node: ast.AST) -> dict:
+    cache: dict[int, ast.AST] = {}
+    stack = [fn_node]
+    while stack:
+        cur = stack.pop()
+        for child in ast.iter_child_nodes(cur):
+            cache[id(child)] = cur
+            stack.append(child)
+    return cache
+
+
+class AwaitAtomicityRule(ProgramRule):
+    id = "await-atomicity"
+    title = "guarded check is stale by the time the write lands"
+    rationale = ("`if <reads self.X>: ... await ...; <writes "
+                 "self.X>` — the await is a scheduling point where "
+                 "any other task may mutate self.X, so the check the "
+                 "branch was entered on no longer holds when the "
+                 "write executes: the PR-3 generation-fence bug "
+                 "shape, where a cache fill raced a delete across an "
+                 "await and re-pinned stale bytes. The write must "
+                 "re-validate after the await — re-read the guard, "
+                 "compare a generation token, or go through a "
+                 "fenced helper (set_if) that re-checks inside; the "
+                 "re-validation may hide one resolved call deep.")
+    example = ("if fid not in self._cache:\n"
+               "    data = await fetch(fid)    # delete() races here\n"
+               "    self._cache[fid] = data    # stale bytes pinned")
+    fix = ("re-check the guard (or a generation token captured "
+           "before the await) after the await, or route the write "
+           "through a compare-and-set helper that re-validates")
+
+    def run(self, program: Program, reporter) -> None:
+        self._read_memo: dict[str, set] = {}
+        for fi in program.table.functions.values():
+            if fi.is_async:
+                self._check(program, fi, reporter)
+
+    def _callee_reads(self, target: FunctionInfo) -> set:
+        reads = self._read_memo.get(target.qual)
+        if reads is None:
+            reads = set()
+            for node in iter_own_nodes(target.node):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load):
+                    attr = _self_attr(node)
+                    if attr:
+                        reads.add(attr)
+            self._read_memo[target.qual] = reads
+        return reads
+
+    def _check(self, program: Program, fi: FunctionInfo,
+               reporter) -> None:
+        sites = {s.node: s for s in program.calls.get(fi.qual, ())}
+        for node in iter_own_nodes(fi.node):
+            if isinstance(node, ast.If):
+                guard = {c[1] for n in ast.walk(node.test)
+                         if isinstance(n, ast.Attribute)
+                         and (c := _self_chain(n))}
+                if guard:
+                    self._check_branch(program, fi, node, guard,
+                                       sites, reporter)
+
+    def _check_branch(self, program: Program, fi: FunctionInfo,
+                      if_node: ast.If, guard: set, sites: dict,
+                      reporter) -> None:
+        body = list(if_node.body)
+        awaits: list[ast.Await] = []
+        writes: list = []       # (node, attr, via_call)
+        for node in _walk_stmts(body):
+            if isinstance(node, ast.Await):
+                awaits.append(node)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr in guard:
+                            writes.append((node, attr, None))
+            elif isinstance(node, ast.Call):
+                chain = _self_chain(node.func)
+                if chain and len(chain) == 3 and chain[1] in guard \
+                        and chain[2] in _MUTATE_TAILS:
+                    writes.append((node, chain[1], None))
+                site = sites.get(node)
+                if site is not None and site.kind == "resolved" \
+                        and site.target is not None \
+                        and not site.target.is_async \
+                        and site.chain and site.chain[0] == "self":
+                    sub = _direct_events(site.target)
+                    for attr in set(sub.regs) & guard:
+                        writes.append((node, attr, site.target))
+        if not awaits or not writes:
+            return
+        for wnode, attr, via in writes:
+            if via is not None and attr in self._callee_reads(via):
+                continue        # fenced helper re-checks inside
+            wsub = {id(n) for n in ast.walk(wnode)}
+            prior = [a for a in awaits
+                     if a.lineno <= wnode.lineno
+                     and id(a) not in wsub]
+            # the collapsed form `self.X[k] = await f()` awaits inside
+            # the write statement itself: the check is equally stale
+            prior += [a for a in awaits if id(a) in wsub
+                      and isinstance(wnode, ast.Assign)]
+            if not prior:
+                continue
+            last_await = max(a.lineno for a in prior)
+            if self._revalidated(program, fi, body, attr, last_await,
+                                 wnode, sites):
+                continue
+            reporter.report(
+                self, fi.rel, wnode.lineno,
+                f"self.{attr} is checked before the await at line "
+                f"{last_await} and written here without "
+                f"re-validation — the guard is stale by write time "
+                f"(another task may have mutated self.{attr} during "
+                f"the await); re-check the guard or use a fenced "
+                f"compare-and-set after the await")
+            return              # one finding per guarded branch
+
+    def _revalidated(self, program: Program, fi: FunctionInfo,
+                     body, attr: str, after_line: int,
+                     wnode: ast.AST, sites: dict) -> bool:
+        wsub = {id(n) for n in ast.walk(wnode)}
+        for node in _walk_stmts(body):
+            lineno = getattr(node, "lineno", None)
+            if lineno is None or id(node) in wsub \
+                    or lineno <= after_line or lineno > wnode.lineno:
+                continue
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and _self_attr(node) == attr:
+                return True
+            if isinstance(node, ast.Call):
+                site = sites.get(node)
+                if site is not None and site.kind == "resolved" \
+                        and site.target is not None \
+                        and site.chain and site.chain[0] == "self" \
+                        and attr in self._callee_reads(site.target):
+                    return True
+        return False
+
+
+_DETACH_DOC_RE = re.compile(
+    r"(?i)\bdetach(ed|es|ing)?\b|\bsurviv\w*\b|\boutliv\w*\b"
+    r"|fire[-_ ]?and[-_ ]?forget")
+
+
+class DetachDisciplineRule(ProgramRule):
+    id = "detach-discipline"
+    title = "documented-detached task spawned with bare create_task"
+    rationale = ("a task that must survive its caller's cancellation "
+                 "carries obligations a bare create_task drops: the "
+                 "handle must be retained (unreferenced tasks may be "
+                 "GC'd mid-flight), its terminal exception consumed "
+                 "(or asyncio logs 'never retrieved' at exit), and "
+                 "the detachment made visible to reviewers. "
+                 "util.aio.detach is the one sanctioned spelling; a "
+                 "create_task whose adjacent comment promises "
+                 "detach/survive/outlive semantics re-implements it "
+                 "ad hoc — the PR-3 singleflight leader did exactly "
+                 "this. Loop tasks whose handle the owner retains "
+                 "and cancels on shutdown are NOT detached and stay "
+                 "plain create_task.")
+    example = ("# runs DETACHED: caller cancellation must not stop it\n"
+               "task = asyncio.create_task(self._run(key, fn))")
+    fix = "task = aio.detach(self._run(key, fn))"
+
+    def run(self, program: Program, reporter) -> None:
+        line_cache: dict[str, list[str]] = {}
+        for fi in program.table.functions.values():
+            if fi.qual in _SANCTIONED_DETACH_QUALS:
+                continue
+            lines = line_cache.get(fi.module.name)
+            if lines is None:
+                lines = fi.module.src.splitlines()
+                line_cache[fi.module.name] = lines
+            for node in iter_own_nodes(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = chain_of(node.func)
+                if not chain or chain[-1] not in ("create_task",
+                                                  "ensure_future"):
+                    continue
+                doc = self._adjacent_comments(lines, node)
+                if doc and _DETACH_DOC_RE.search(doc):
+                    reporter.report(
+                        self, fi.rel, node.lineno,
+                        f"task documented to outlive its caller "
+                        f"({_short(fi.qual)}()) is spawned with bare "
+                        f"{chain[-1]} — use util.aio.detach, the "
+                        f"sanctioned detach helper (retains the "
+                        f"handle, consumes the terminal exception, "
+                        f"and names the intent)")
+
+    @staticmethod
+    def _adjacent_comments(lines: list[str], node: ast.Call) -> str:
+        """The contiguous comment block directly above the call plus
+        inline comments on the call's own lines."""
+        out: list[str] = []
+        i = node.lineno - 2                     # line above, 0-based
+        while i >= 0 and lines[i].lstrip().startswith("#"):
+            out.append(lines[i].lstrip())
+            i -= 1
+        end = getattr(node, "end_lineno", node.lineno) or node.lineno
+        for ln in range(node.lineno - 1, min(end, len(lines))):
+            _, _, comment = lines[ln].partition("#")
+            if comment:
+                out.append(comment)
+        return "\n".join(out)

@@ -46,7 +46,8 @@ class FunctionInfo:
     """One def/async def: module-level function or class method."""
 
     __slots__ = ("module", "cls", "name", "qual", "node", "is_async",
-                 "is_generator", "rel", "lineno", "var_types")
+                 "is_generator", "rel", "lineno", "var_types",
+                 "var_funcs")
 
     def __init__(self, module: "ModuleInfo", cls: "ClassInfo | None",
                  node: ast.AST):
@@ -65,6 +66,10 @@ class FunctionInfo:
         self.rel = module.rel
         self.lineno = node.lineno
         self.var_types: dict[str, str] = {}   # local name -> chain str
+        # local name -> FunctionInfo, from bound-method aliases
+        # (`f = self.method`) and `functools.partial(self.method, x)`
+        # — callgraph.py fills this and resolves `f()` through it
+        self.var_funcs: dict[str, "FunctionInfo"] = {}
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"<fn {self.qual}>"
